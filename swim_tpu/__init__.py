@@ -1,0 +1,40 @@
+"""swim_tpu — a TPU-native SWIM gossip / failure-detection framework.
+
+Built from scratch against the capabilities of the Haskell reference
+`jpfuentes2/swim` (see SURVEY.md): the per-node protocol tick — randomized
+ping-target selection, k-indirect probing, piggybacked gossip dissemination,
+suspicion/incarnation state transitions — plus transports, codec, and a node
+runtime; and, as the north star, a vectorized simulator that runs the
+protocol for millions of virtual nodes as one jit-compiled JAX step over a
+sharded TPU mesh.
+
+Layering:
+  swim_tpu.types / config   — protocol lattice & constants (pure Python)
+  swim_tpu.core             — real-node framework: membership, suspicion,
+                              gossip buffer, codec, Transport ABC
+                              (in-process + UDP), Node runtime, demo CLI
+  swim_tpu.models           — simulators: scalar oracle, dense O(N²) engine,
+                              scalable O(R·N) rumor engine
+  swim_tpu.ops              — vectorized building blocks (lattice, sampling,
+                              mailbox delivery, Pallas kernels)
+  swim_tpu.parallel         — mesh construction, sharded step, collectives
+  swim_tpu.sim              — fault injection, runners, metrics collection
+  swim_tpu.bridge           — gRPC contract for driving the simulator from an
+                              external (e.g. Haskell) SWIM core
+"""
+
+__version__ = "0.1.0"
+
+from swim_tpu.config import STOCK_DEMO, SwimConfig
+from swim_tpu.types import MsgKind, Opinion, Status, Update, merge
+
+__all__ = [
+    "STOCK_DEMO",
+    "SwimConfig",
+    "MsgKind",
+    "Opinion",
+    "Status",
+    "Update",
+    "merge",
+    "__version__",
+]

@@ -1,0 +1,52 @@
+"""swim-tpu command-line interface.
+
+Mirrors the reference's demo executable (stock config: 32-node in-process
+cluster, k=3, 1 s period — BASELINE.json configs[0]) and fronts the
+simulators. Subcommands grow with the framework; `info` is always available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_info(args: argparse.Namespace) -> int:
+    import swim_tpu
+
+    cfg = swim_tpu.SwimConfig(n_nodes=args.nodes)
+    print(json.dumps({
+        "version": swim_tpu.__version__,
+        "n_nodes": cfg.n_nodes,
+        "k_indirect": cfg.k_indirect,
+        "protocol_period_s": cfg.protocol_period,
+        "suspicion_periods": cfg.suspicion_periods,
+        "retransmit_limit": cfg.retransmit_limit,
+        "max_piggyback": cfg.max_piggyback,
+        "rumor_slots": cfg.rumor_slots,
+    }, indent=2))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="swim-tpu",
+        description="TPU-native SWIM failure-detection framework & simulator",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    info = sub.add_parser("info", help="show derived protocol constants")
+    info.add_argument("--nodes", type=int, default=32)
+    info.set_defaults(fn=_cmd_info)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

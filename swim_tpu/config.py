@@ -1,0 +1,115 @@
+"""Protocol configuration for swim_tpu.
+
+The reference (jpfuentes2/swim, Haskell — tree unavailable at survey time, see
+SURVEY.md §0) exposes its protocol constants through the stock demo config:
+32 nodes, k=3 indirect probes, 1 s protocol period (BASELINE.json configs[0]).
+This module is the single source of truth for those constants in swim_tpu.
+
+`SwimConfig` is a frozen, hashable dataclass so it can be passed as a *static*
+argument to `jax.jit` — every field is a compile-time constant, which lets XLA
+specialize shapes (n_nodes, rumor capacity) and unroll the per-period message
+waves with no dynamic control flow.
+
+Fault injection parameters live in `FaultPlan` (swim_tpu/sim/faults.py) as
+*runtime* tensors instead, so parameter sweeps (loss rate, crash schedules,
+partitions — BASELINE.md configs 2–5) reuse one compiled step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+
+@dataclasses.dataclass(frozen=True)
+class SwimConfig:
+    """Static protocol constants (one compiled step per distinct config).
+
+    Timeouts and dissemination bounds follow the SWIM paper (Das et al., DSN
+    2002) and the Lifeguard paper (Dadgar et al., 2017); the log-scaled
+    multiplier form matches common production practice so sweeps over
+    `suspicion_mult` (BASELINE.md config 4) are directly meaningful.
+    """
+
+    n_nodes: int
+    # --- failure detector ---
+    k_indirect: int = 3          # indirect probe fan-out (stock demo: k=3)
+    protocol_period: float = 1.0  # seconds; real-node runtime only — the
+    #                               vectorized engines use "periods" as the
+    #                               unit of simulated time.
+    # --- dissemination ---
+    max_piggyback: int = 6       # B: max updates piggybacked per message
+    retransmit_mult: float = 4.0  # gossip an update for ~mult*log10(N) sends
+    # --- suspicion subprotocol ---
+    suspicion_mult: float = 5.0  # suspicion timeout = mult * log10(N) periods
+    # --- probe target selection ---
+    target_selection: str = "uniform"  # "uniform" | "round_robin"
+    # --- Lifeguard extensions (Dadgar et al., 2017), switchable variants ---
+    lifeguard: bool = False      # master switch (config 5 vs vanilla SWIM)
+    lha_max: int = 8             # local-health-aware probe: max health score S;
+    #                              probe timeout scales by (1 + S/lha_max).
+    dynamic_suspicion: bool = True   # suspicion timeout shrinks with
+    #                                  independent confirmations
+    suspicion_min_mult: float = 1.0  # floor of the dynamic suspicion timeout
+    buddy: bool = True           # buddy system: prioritize telling a suspect
+    #                              it is suspected so it can refute fast
+    # --- engine capacity knobs (rumor engine only) ---
+    rumor_capacity: int = 0      # 0 → sized automatically from n_nodes
+    sentinels: int = 4           # independent suspectors tracked per rumor
+
+    def __post_init__(self):
+        if self.n_nodes < 2:
+            raise ValueError("SWIM needs at least 2 nodes")
+        if self.target_selection not in ("uniform", "round_robin"):
+            raise ValueError(f"bad target_selection {self.target_selection!r}")
+
+    # -- derived constants (plain Python: evaluated at trace time) ----------
+
+    @property
+    def log_n(self) -> float:
+        return max(1.0, math.log10(max(self.n_nodes, 10)))
+
+    @property
+    def retransmit_limit(self) -> int:
+        """How many times a node re-gossips one update before dropping it.
+
+        Infection-style dissemination reaches all N nodes w.h.p. in
+        O(log N) rounds; the bound mirrors that.
+        """
+        return max(1, math.ceil(self.retransmit_mult * self.log_n))
+
+    @property
+    def suspicion_periods(self) -> int:
+        """Suspicion timeout, in protocol periods (vanilla / Lifeguard max)."""
+        return max(1, math.ceil(self.suspicion_mult * self.log_n))
+
+    @property
+    def suspicion_min_periods(self) -> int:
+        """Lifeguard dynamic-suspicion floor, in protocol periods."""
+        return max(1, math.ceil(self.suspicion_min_mult * self.log_n))
+
+    @property
+    def gossip_window(self) -> int:
+        """Periods for which a rumor stays transmissible (rumor engine).
+
+        A node makes Θ(1) sends per period, so `retransmit_limit` sends
+        ≈ `retransmit_limit` periods of eligibility.
+        """
+        return self.retransmit_limit
+
+    @property
+    def rumor_slots(self) -> int:
+        """Rumor table capacity R for the O(R·N) rumor engine."""
+        if self.rumor_capacity:
+            return self.rumor_capacity
+        # Enough for moderate churn: a few hundred concurrent rumors minimum,
+        # scaled gently with N. Overflow is counted, never silent.
+        return int(min(4096, max(256, self.n_nodes // 64)))
+
+    def replace(self, **kw) -> "SwimConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# The reference's stock demo configuration: 32-node in-process cluster,
+# k=3 indirect probes, 1 s protocol period (BASELINE.json configs[0]).
+STOCK_DEMO = SwimConfig(n_nodes=32, k_indirect=3, protocol_period=1.0)

@@ -1,0 +1,63 @@
+"""Vectorized membership-state lattice (JAX mirror of swim_tpu/types.py).
+
+Opinions are packed into a single uint32 key so that the SWIM merge rule —
+DEAD sticky, then higher incarnation, then SUSPECT > ALIVE — is exactly
+`jnp.maximum`/scatter-max. Associativity/commutativity of `max` is what lets
+a whole message wave merge in one scatter regardless of delivery order
+(docs/PROTOCOL.md §3).
+
+Layout (must match types.opinion_key):  key = dead<<31 | inc<<1 | suspect
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from swim_tpu.types import INC_MAX, Status
+
+def pack(status, incarnation):
+    """status u8/int [any shape], incarnation u32 → key u32."""
+    status = jnp.asarray(status, jnp.uint32)
+    inc = jnp.minimum(jnp.asarray(incarnation, jnp.uint32),
+                      jnp.uint32(INC_MAX))
+    dead = (status == Status.DEAD).astype(jnp.uint32) << 31
+    suspect = (status == Status.SUSPECT).astype(jnp.uint32)
+    return dead | (inc << 1) | suspect
+
+
+def status_of(key):
+    key = jnp.asarray(key, jnp.uint32)
+    dead = (key >> 31) == 1
+    suspect = (key & 1) == 1
+    return jnp.where(dead, jnp.uint8(Status.DEAD),
+                     jnp.where(suspect, jnp.uint8(Status.SUSPECT),
+                               jnp.uint8(Status.ALIVE)))
+
+
+def incarnation_of(key):
+    return (jnp.asarray(key, jnp.uint32) >> 1) & jnp.uint32(INC_MAX)
+
+
+def merge(a, b):
+    """Lattice join == max over packed keys."""
+    return jnp.maximum(a, b)
+
+
+def is_dead(key):
+    return (jnp.asarray(key, jnp.uint32) >> 31) == 1
+
+
+def is_suspect(key):
+    return (~is_dead(key)) & ((key & 1) == 1)
+
+
+def alive_key(incarnation):
+    return pack(jnp.uint8(Status.ALIVE), incarnation)
+
+
+def suspect_key(incarnation):
+    return pack(jnp.uint8(Status.SUSPECT), incarnation)
+
+
+def dead_key(incarnation):
+    return pack(jnp.uint8(Status.DEAD), incarnation)

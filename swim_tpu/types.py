@@ -1,0 +1,110 @@
+"""Core protocol types and the membership-state lattice.
+
+SWIM's correctness hinges on one algebraic fact: merging two opinions about a
+member — (status, incarnation) pairs — is an associative, commutative,
+idempotent join on a lattice.  That is exactly what makes the whole protocol
+vectorizable on TPU: every gossip merge in a message wave can be applied in
+any order (a scatter-max), so one `jit`-compiled step can process all N nodes'
+messages simultaneously without replaying per-message ordering.
+
+Precedence (SWIM paper, Das et al. DSN 2002, §4.2):
+  * DEAD is sticky: a confirm overrides ALIVE/SUSPECT of any incarnation.
+  * Otherwise higher incarnation wins.
+  * At equal incarnation, SUSPECT > ALIVE.
+
+We encode an opinion as a single uint32 priority key so the join is `max`:
+
+    key = (is_dead << 31) | (incarnation << 1) | is_suspect
+
+(incarnation saturates at 2**30 - 1; it only grows via refutations, one per
+suspicion of that node, so saturation is unreachable in practice — keys
+compare equal at the clamp, making ties possible there but nowhere else.)
+
+This module is pure Python + ints — shared by the scalar oracle
+(`swim_tpu.models.oracle`), the real-node framework (`swim_tpu.core`), and
+the wire codec. The JAX mirror of these ops lives in `swim_tpu.ops.lattice`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+INC_MAX = (1 << 30) - 1
+
+
+class Status(enum.IntEnum):
+    ALIVE = 0
+    SUSPECT = 1
+    DEAD = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class Opinion:
+    """One node's belief about one member: (status, incarnation).
+
+    Deliberately NOT orderable: SWIM precedence is `merge`/`key()`, and a
+    lexicographic dataclass order would silently disagree with it.
+    """
+
+    status: Status
+    incarnation: int
+
+    def key(self) -> int:
+        return opinion_key(int(self.status), self.incarnation)
+
+
+def opinion_key(status: int, incarnation: int) -> int:
+    """Total-order key; lattice join == max over keys."""
+    inc = min(incarnation, INC_MAX)
+    if status == Status.DEAD:
+        return (1 << 31) | (inc << 1)
+    return (inc << 1) | (1 if status == Status.SUSPECT else 0)
+
+
+def key_status(key: int) -> int:
+    if key >> 31:
+        return int(Status.DEAD)
+    return int(Status.SUSPECT) if (key & 1) else int(Status.ALIVE)
+
+
+def key_incarnation(key: int) -> int:
+    return (key >> 1) & INC_MAX
+
+
+def merge(a: Opinion, b: Opinion) -> Opinion:
+    """Lattice join of two opinions (associative, commutative, idempotent)."""
+    return a if a.key() >= b.key() else b
+
+
+def supersedes(a: Opinion, b: Opinion) -> bool:
+    """True iff learning `a` changes a view currently holding `b`.
+
+    "New information" in SWIM terms — the trigger for re-gossiping an update
+    (reset of its retransmit counter).
+    """
+    return a.key() > b.key()
+
+
+@dataclasses.dataclass(frozen=True)
+class Update:
+    """A membership update as disseminated by gossip: member + opinion."""
+
+    member: int
+    status: Status
+    incarnation: int
+
+    @property
+    def opinion(self) -> Opinion:
+        return Opinion(self.status, self.incarnation)
+
+
+class MsgKind(enum.IntEnum):
+    """Wire message kinds (mirrors the reference's ping/ping-req/ack set)."""
+
+    PING = 0
+    PING_REQ = 1
+    ACK = 2
+    NACK = 3      # Lifeguard: explicit negative ack from a probe relay
+    JOIN = 4      # join request to a seed
+    JOIN_REPLY = 5  # membership snapshot

@@ -1,0 +1,64 @@
+"""Per-period randomness, drawn up front as tensors.
+
+Contract: ALL random choices of protocol period `t` come from
+`draw_period(key, t, cfg)` — the scalar oracle consumes the same tensors
+element-wise that the dense engine consumes vectorized, so the two can be
+compared bitwise (tests/test_dense_vs_oracle.py).
+
+`jax.random.fold_in(key, t)` gives an O(1), order-independent stream per
+period — no PRNG state threads through `lax.scan`, keys are derived, which
+also makes checkpoint/resume trivial (store the root key + step only).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from swim_tpu.config import SwimConfig
+
+
+class PeriodRandomness(NamedTuple):
+    """Every random draw used by one protocol period (see docs/PROTOCOL.md §3).
+
+    Uniform f32 in [0, 1); Bernoulli decisions compare against rates at the
+    use site so fault parameters stay runtime values.
+    """
+
+    target_u: jax.Array    # [N]    probe target selection
+    proxy_u: jax.Array     # [N, k] proxy selection (per slot)
+    loss_w1: jax.Array     # [N]    PING i→T(i)
+    loss_w2: jax.Array     # [N]    ACK T(i)→i          (indexed by pinger i)
+    loss_w3: jax.Array     # [N, k] PING-REQ i→p
+    loss_w4: jax.Array     # [N, k] proxy PING p→T(i)
+    loss_w5: jax.Array     # [N, k] target ACK T(i)→p
+    loss_w6: jax.Array     # [N, k] relay ACK p→i
+    lha_u: jax.Array       # [N]    Lifeguard LHA probe thinning
+
+
+def draw_period(key: jax.Array, step: jax.Array | int,
+                cfg: SwimConfig) -> PeriodRandomness:
+    n, k = cfg.n_nodes, cfg.k_indirect
+    pk = jax.random.fold_in(key, step)
+    ks = jax.random.split(pk, 9)
+    u = jax.random.uniform
+    return PeriodRandomness(
+        target_u=u(ks[0], (n,)),
+        proxy_u=u(ks[1], (n, k)),
+        loss_w1=u(ks[2], (n,)),
+        loss_w2=u(ks[3], (n,)),
+        loss_w3=u(ks[4], (n, k)),
+        loss_w4=u(ks[5], (n, k)),
+        loss_w5=u(ks[6], (n, k)),
+        loss_w6=u(ks[7], (n, k)),
+        lha_u=u(ks[8], (n,)),
+    )
+
+
+def to_numpy(r: PeriodRandomness) -> PeriodRandomness:
+    """Host copies for the scalar oracle."""
+    import numpy as np
+
+    return PeriodRandomness(*(np.asarray(x) for x in r))

@@ -1,0 +1,19 @@
+"""Test harness: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Multi-chip shardings (swim_tpu.parallel) are validated on 8 virtual CPU
+devices, mirroring how the driver dry-runs `__graft_entry__.dryrun_multichip`.
+Real-TPU benchmarking happens in bench.py, not here.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402  (import after env setup, on purpose)
+
+jax.config.update("jax_threefry_partitionable", True)

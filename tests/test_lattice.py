@@ -1,0 +1,128 @@
+"""Property tests for the membership-state lattice (types.py ⟷ ops/lattice.py).
+
+The SWIM precedence rules (paper §4.2) and the algebraic laws that make the
+vectorized engines correct: associativity, commutativity, idempotence, and
+agreement between the scalar and packed-key implementations.
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swim_tpu import Opinion, Status, merge
+from swim_tpu.ops import lattice
+from swim_tpu.types import key_incarnation, key_status, opinion_key, supersedes
+
+
+def rand_opinion(rng):
+    return Opinion(Status(rng.randrange(3)), rng.randrange(0, 50))
+
+
+def test_swim_precedence_rules():
+    a5, s5, d5 = (Opinion(st, 5) for st in
+                  (Status.ALIVE, Status.SUSPECT, Status.DEAD))
+    a6 = Opinion(Status.ALIVE, 6)
+    s4 = Opinion(Status.SUSPECT, 4)
+    # suspect beats alive at equal incarnation
+    assert merge(a5, s5) == s5
+    # higher incarnation alive refutes suspicion
+    assert merge(s5, a6) == a6
+    # alive with lower/equal incarnation does not refute
+    assert merge(s5, a5) == s5
+    assert merge(s4, a5) == a5  # alive@5 beats suspect@4 (paper: overrides j<i)
+    # dead is sticky against any incarnation
+    assert merge(d5, Opinion(Status.ALIVE, 49)) == d5
+    assert merge(d5, Opinion(Status.SUSPECT, 49)) == d5
+    # among dead claims, higher incarnation retained
+    assert merge(d5, Opinion(Status.DEAD, 7)) == Opinion(Status.DEAD, 7)
+
+
+def test_merge_laws():
+    rng = random.Random(0)
+    for _ in range(500):
+        a, b, c = (rand_opinion(rng) for _ in range(3))
+        assert merge(a, b) == merge(b, a)
+        assert merge(a, merge(b, c)) == merge(merge(a, b), c)
+        assert merge(a, a) == a
+        assert merge(a, b) in (a, b)
+
+
+def test_supersedes_is_strict_order():
+    rng = random.Random(1)
+    for _ in range(200):
+        a, b = rand_opinion(rng), rand_opinion(rng)
+        assert not (supersedes(a, b) and supersedes(b, a))
+        if a != b:
+            assert supersedes(a, b) or supersedes(b, a) or \
+                a.key() == b.key()
+
+
+def test_key_roundtrip_scalar():
+    rng = random.Random(2)
+    for _ in range(200):
+        o = rand_opinion(rng)
+        k = opinion_key(int(o.status), o.incarnation)
+        assert key_status(k) == int(o.status)
+        assert key_incarnation(k) == o.incarnation
+
+
+def test_jax_pack_matches_scalar():
+    rng = random.Random(3)
+    statuses = np.array([rng.randrange(3) for _ in range(256)], np.uint8)
+    incs = np.array([rng.randrange(0, 10**6) for _ in range(256)], np.uint32)
+    keys = lattice.pack(statuses, incs)
+    expect = np.array(
+        [opinion_key(int(s), int(i)) for s, i in zip(statuses, incs)],
+        np.uint32)
+    np.testing.assert_array_equal(np.asarray(keys), expect)
+    np.testing.assert_array_equal(np.asarray(lattice.status_of(keys)),
+                                  statuses)
+    np.testing.assert_array_equal(np.asarray(lattice.incarnation_of(keys)),
+                                  incs)
+
+
+def test_jax_merge_is_max_and_matches_scalar():
+    rng = random.Random(4)
+    a = [rand_opinion(rng) for _ in range(256)]
+    b = [rand_opinion(rng) for _ in range(256)]
+    ka = lattice.pack(np.array([int(o.status) for o in a], np.uint8),
+                      np.array([o.incarnation for o in a], np.uint32))
+    kb = lattice.pack(np.array([int(o.status) for o in b], np.uint8),
+                      np.array([o.incarnation for o in b], np.uint32))
+    km = lattice.merge(ka, kb)
+    expect = [merge(x, y) for x, y in zip(a, b)]
+    np.testing.assert_array_equal(
+        np.asarray(lattice.status_of(km)),
+        np.array([int(o.status) for o in expect], np.uint8))
+    np.testing.assert_array_equal(
+        np.asarray(lattice.incarnation_of(km)),
+        np.array([o.incarnation for o in expect], np.uint32))
+
+
+def test_predicates():
+    k = lattice.pack(np.array([0, 1, 2], np.uint8),
+                     np.array([3, 3, 3], np.uint32))
+    np.testing.assert_array_equal(np.asarray(lattice.is_dead(k)),
+                                  [False, False, True])
+    np.testing.assert_array_equal(np.asarray(lattice.is_suspect(k)),
+                                  [False, True, False])
+    assert jnp.all(lattice.alive_key(jnp.uint32(3)) == k[0])
+    assert jnp.all(lattice.suspect_key(jnp.uint32(3)) == k[1])
+    assert jnp.all(lattice.dead_key(jnp.uint32(3)) == k[2])
+
+
+def test_config_derived_constants():
+    from swim_tpu import STOCK_DEMO, SwimConfig
+    assert STOCK_DEMO.n_nodes == 32 and STOCK_DEMO.k_indirect == 3
+    assert STOCK_DEMO.protocol_period == 1.0
+    c = SwimConfig(n_nodes=1000)
+    assert c.suspicion_periods == 15          # 5 * log10(1000)
+    assert c.retransmit_limit == 12           # 4 * log10(1000)
+    with pytest.raises(ValueError):
+        SwimConfig(n_nodes=1)
+    with pytest.raises(ValueError):
+        SwimConfig(n_nodes=8, target_selection="bogus")
+    # hashable → usable as a jit static argument
+    assert hash(c) == hash(SwimConfig(n_nodes=1000))

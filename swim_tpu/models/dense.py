@@ -1,0 +1,291 @@
+"""Dense vectorized SWIM engine — exact O(N²)-state simulation in one jit step.
+
+The whole cluster's protocol period — target sampling, six message waves,
+suspicion, refutation, expiry (docs/PROTOCOL.md) — executes as one traced
+JAX program over [N, N] tensors:
+
+  * view keys `u32[N, N]` merge by scatter-max (the lattice join commutes,
+    so a wave's deliveries need no ordering),
+  * piggyback selection is a per-row top-B over (retransmit_count, subject),
+  * message delivery is gather (payload from sender rows) + scatter (into
+    receiver rows), with crash/partition/loss as multiplicative masks.
+
+No data-dependent control flow: every wave always "runs" with boolean sent
+masks, which is what lets XLA compile a single static program and fuse the
+elementwise fault masks into the scatters.
+
+Contract: bitwise-identical state evolution to the scalar oracle
+(swim_tpu/models/oracle.py) given the same PeriodRandomness tensors —
+enforced by tests/test_dense_vs_oracle.py. Exactness makes this the gold
+reference for the scalable rumor engine, and the engine of choice up to
+~10k nodes (memory is 9·N² bytes + transients).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.ops import lattice
+from swim_tpu.sim.faults import FaultPlan
+from swim_tpu.utils.prng import PeriodRandomness, draw_period
+
+NO_DEADLINE = jnp.int32(2**31 - 1)
+_RANK_INF = jnp.int32(2**30)
+
+
+class DenseState(NamedTuple):
+    """Mirrors oracle.OracleState field-for-field (bitwise comparable)."""
+
+    key: jax.Array         # u32[N, N]  view: key[i, j] = i's opinion of j
+    retransmit: jax.Array  # i32[N, N]  gossip send counts
+    deadline: jax.Array    # i32[N, N]  suspicion expiry period
+    lha: jax.Array         # i32[N]     Lifeguard local health score
+    step: jax.Array        # i32 scalar periods completed
+
+
+def init_state(cfg: SwimConfig) -> DenseState:
+    n = cfg.n_nodes
+    return DenseState(
+        key=jnp.full((n, n), lattice.alive_key(jnp.uint32(0)), jnp.uint32),
+        retransmit=jnp.full((n, n), cfg.retransmit_limit, jnp.int32),
+        deadline=jnp.full((n, n), NO_DEADLINE, jnp.int32),
+        lha=jnp.zeros((n,), jnp.int32),
+        step=jnp.int32(0),
+    )
+
+
+def _masked_pick(mask: jax.Array, u: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Uniform pick over each row's True positions (oracle's float32 math).
+
+    mask: bool[..., N]; u: f32[...] → (index[...], valid[...]).
+    Picks the (floor(u·c)+1)-th set bit; valid iff the row has any.
+    """
+    c = jnp.sum(mask, axis=-1).astype(jnp.int32)
+    idx = (u * c.astype(jnp.float32)).astype(jnp.int32)
+    idx = jnp.minimum(idx, jnp.maximum(c - 1, 0))
+    cum = jnp.cumsum(mask.astype(jnp.int32), axis=-1)
+    pick = jnp.argmax(cum > idx[..., None], axis=-1).astype(jnp.int32)
+    return pick, c > 0
+
+
+def _piggyback(cfg: SwimConfig, retransmit: jax.Array):
+    """Per-sender top-B selection: fewest retransmissions first, ties by id.
+
+    Returns (sel_idx i32[N, B], sel_valid bool[N, B]).
+    """
+    n, b = cfg.n_nodes, cfg.max_piggyback
+    j_ids = jnp.arange(n, dtype=jnp.int32)
+    rank = retransmit * jnp.int32(n + 1) + j_ids[None, :]
+    rank = jnp.where(retransmit < cfg.retransmit_limit, rank, _RANK_INF)
+    neg_vals, sel_idx = jax.lax.top_k(-rank, b)
+    return sel_idx.astype(jnp.int32), neg_vals > -_RANK_INF
+
+
+def _apply_forced(cfg: SwimConfig, sel_idx, sel_valid, forced):
+    """Lifeguard buddy: prepend `forced` subject (-1 = none) if absent."""
+    present = jnp.any(sel_valid & (sel_idx == forced[..., None]), axis=-1)
+    need = (forced >= 0) & ~present
+    f_idx = jnp.concatenate(
+        [jnp.maximum(forced, 0)[..., None], sel_idx[..., :-1]], axis=-1)
+    f_valid = jnp.concatenate(
+        [jnp.ones_like(forced[..., None], dtype=bool), sel_valid[..., :-1]],
+        axis=-1)
+    sel_idx = jnp.where(need[..., None], f_idx, sel_idx)
+    sel_valid = jnp.where(need[..., None], f_valid, sel_valid)
+    return sel_idx, sel_valid
+
+
+def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
+         rnd: PeriodRandomness) -> DenseState:
+    """One protocol period for all N nodes (pure; jit with cfg static)."""
+    n, k = cfg.n_nodes, cfg.k_indirect
+    t = state.step
+    key, retransmit, deadline, lha = (state.key, state.retransmit,
+                                      state.deadline, state.lha)
+    ids = jnp.arange(n, dtype=jnp.int32)
+    crashed = t >= plan.crash_step                     # bool[N]
+    up = ~crashed
+    part_on = ((t >= plan.partition_start) & (t < plan.partition_end))
+
+    def delivered(src, dst, u):
+        """Fault mask for a batch of directed messages (docs/PROTOCOL.md §3)."""
+        cut = part_on & (plan.partition_id[src] != plan.partition_id[dst])
+        return (~crashed[src] & ~crashed[dst] & ~cut
+                & (u >= plan.loss.astype(jnp.float32)))
+
+    # ---- Phase A: all random choices --------------------------------------
+    not_dead = ~lattice.is_dead(key)
+    cand = not_dead & (ids[None, :] != ids[:, None])   # bool[N, N]
+    target, has_cand = _masked_pick(cand, rnd.target_u)
+    prober = up & has_cand                             # i sends a W1 ping
+    cand2 = cand & (ids[None, :] != target[:, None])
+    # proxies: k independent picks over cand2 (same row mask per slot)
+    c2 = jnp.sum(cand2, axis=-1).astype(jnp.int32)
+    idx2 = (rnd.proxy_u * c2[:, None].astype(jnp.float32)).astype(jnp.int32)
+    idx2 = jnp.minimum(idx2, jnp.maximum(c2 - 1, 0)[:, None])
+    cum2 = jnp.cumsum(cand2.astype(jnp.int32), axis=-1)
+    proxies = jnp.argmax(cum2[:, None, :] > idx2[:, :, None],
+                         axis=-1).astype(jnp.int32)    # i32[N, k]
+    has_proxy = c2 > 0
+
+    susp_key_row = lattice.is_suspect(key)             # for buddy forcing
+
+    def buddy(src, dst):
+        """forced subject per message: dst if src believes dst SUSPECT."""
+        if not (cfg.lifeguard and cfg.buddy):
+            return jnp.full(src.shape, -1, jnp.int32)
+        return jnp.where(susp_key_row[src, dst], dst, jnp.int32(-1))
+
+    def wave(carry, src, dst, sent, u_loss, forced):
+        """Run one message wave; returns updated carry and delivered mask.
+
+        carry = (key, retransmit, deadline). src/dst/sent/u_loss/forced are
+        flat message arrays of equal length M (static).
+        """
+        key, retransmit, deadline = carry
+        sel_idx, sel_valid = _piggyback(cfg, retransmit)   # wave-start state
+        msel = sel_idx[src]                                # [M, B]
+        mval = sel_valid[src]
+        msel, mval = _apply_forced(cfg, msel, mval, forced)
+        mval = mval & sent[:, None]
+        payload = key[src[:, None], msel]                  # [M, B] u32
+        # counters advance for every sent message, delivered or not
+        retransmit = retransmit.at[src[:, None], msel].add(
+            mval.astype(jnp.int32))
+        ok = sent & delivered(src, dst, u_loss)            # [M]
+        dval = mval & ok[:, None]
+        new_key = key.at[dst[:, None], msel].max(
+            jnp.where(dval, payload, jnp.uint32(0)))
+        changed = new_key > key
+        retransmit = jnp.where(changed, 0, retransmit)
+        deadline = jnp.where(
+            changed,
+            jnp.where(lattice.is_suspect(new_key),
+                      t + jnp.int32(cfg.suspicion_periods), NO_DEADLINE),
+            deadline)
+        return (new_key, retransmit, deadline), ok
+
+    carry = (key, retransmit, deadline)
+
+    # W1: pings i → T(i)
+    carry, w1_ok = wave(carry, ids, target, prober, rnd.loss_w1,
+                        buddy(ids, target))
+    # W2: acks T(i) → i (one per delivered ping, indexed by pinger i)
+    no_force = jnp.full((n,), -1, jnp.int32)
+    carry, w2_ok = wave(carry, target, ids, w1_ok, rnd.loss_w2, no_force)
+    acked = w2_ok
+    # W3: ping-req i → proxies, for probers with no direct ack
+    need = prober & ~acked & has_proxy
+    src3 = jnp.repeat(ids, k)
+    dst3 = proxies.reshape(-1)
+    sent3 = jnp.repeat(need, k)
+    carry, w3_ok = wave(carry, src3, dst3, sent3, rnd.loss_w3.reshape(-1),
+                        jnp.full((n * k,), -1, jnp.int32))
+    # W4: proxy pings p → T(i)
+    tgt4 = jnp.repeat(target, k)
+    carry, w4_ok = wave(carry, dst3, tgt4, w3_ok, rnd.loss_w4.reshape(-1),
+                        buddy(dst3, tgt4))
+    # W5: target acks T(i) → p
+    carry, w5_ok = wave(carry, tgt4, dst3, w4_ok, rnd.loss_w5.reshape(-1),
+                        jnp.full((n * k,), -1, jnp.int32))
+    # W6: relay acks p → i
+    carry, w6_ok = wave(carry, dst3, src3, w5_ok, rnd.loss_w6.reshape(-1),
+                        jnp.full((n * k,), -1, jnp.int32))
+    key, retransmit, deadline = carry
+    relayed = jnp.any(w6_ok.reshape(n, k), axis=-1)
+
+    # ---- End of period (docs/PROTOCOL.md §3) ------------------------------
+
+    # 1. probe verdicts (health read at probe time, updated after)
+    probe_ok = acked | relayed
+    failed = prober & ~probe_ok
+    s_probe = lha
+    if cfg.lifeguard:
+        lha = jnp.where(prober,
+                        jnp.clip(lha + jnp.where(failed, 1, -1), 0,
+                                 cfg.lha_max), lha)
+        thin = rnd.lha_u < (jnp.float32(1.0) /
+                            (1 + s_probe).astype(jnp.float32))
+        failed = failed & thin
+    cur_tk = key[ids, target]
+    mk_suspect = failed & (lattice.status_of(cur_tk) == 0)  # currently ALIVE
+    susp = lattice.suspect_key(lattice.incarnation_of(cur_tk))
+    new_tk = jnp.where(mk_suspect, jnp.maximum(cur_tk, susp), cur_tk)
+    ch = new_tk > cur_tk
+    key = key.at[ids, target].set(new_tk)
+    retransmit = retransmit.at[ids, target].set(
+        jnp.where(ch, 0, retransmit[ids, target]))
+    deadline = deadline.at[ids, target].set(
+        jnp.where(ch, t + jnp.int32(cfg.suspicion_periods),
+                  deadline[ids, target]))
+
+    # 2. refutation: live node that sees itself suspected bumps incarnation
+    self_k = key[ids, ids]
+    refute = up & lattice.is_suspect(self_k)
+    new_self = jnp.where(
+        refute, lattice.alive_key(lattice.incarnation_of(self_k) + 1), self_k)
+    key = key.at[ids, ids].set(new_self)
+    retransmit = retransmit.at[ids, ids].set(
+        jnp.where(refute, 0, retransmit[ids, ids]))
+    deadline = deadline.at[ids, ids].set(
+        jnp.where(refute, NO_DEADLINE, deadline[ids, ids]))
+    if cfg.lifeguard:
+        lha = jnp.where(refute, jnp.clip(lha + 1, 0, cfg.lha_max), lha)
+
+    # 3. suspicion expiry → DEAD, gossip the confirm
+    expire = (lattice.is_suspect(key) & (deadline <= t) & up[:, None])
+    key = jnp.where(expire, lattice.dead_key(lattice.incarnation_of(key)),
+                    key)
+    retransmit = jnp.where(expire, 0, retransmit)
+    deadline = jnp.where(expire, NO_DEADLINE, deadline)
+
+    # crashed nodes are frozen: restore their rows wholesale
+    frozen = crashed[:, None]
+    key = jnp.where(frozen, state.key, key)
+    retransmit = jnp.where(frozen, state.retransmit, retransmit)
+    deadline = jnp.where(frozen, state.deadline, deadline)
+    lha = jnp.where(crashed, state.lha, lha)
+
+    return DenseState(key, retransmit, deadline, lha, t + 1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
+        root_key: jax.Array, periods: int) -> DenseState:
+    """Run `periods` protocol periods under one fused lax.scan."""
+
+    def body(st, _):
+        rnd = draw_period(root_key, st.step, cfg)
+        return step(cfg, st, plan, rnd), None
+
+    state, _ = jax.lax.scan(body, state, None, length=periods)
+    return state
+
+
+class DenseEngine:
+    """Convenience wrapper holding (cfg, plan, state) with a jitted step."""
+
+    def __init__(self, cfg: SwimConfig, plan: FaultPlan,
+                 root_key: jax.Array | None = None):
+        self.cfg = cfg
+        self.plan = plan
+        self.root_key = (root_key if root_key is not None
+                         else jax.random.key(0))
+        self.state = init_state(cfg)
+        self._step = jax.jit(functools.partial(step, cfg))
+
+    def run(self, periods: int) -> DenseState:
+        self.state = run(self.cfg, self.state, self.plan, self.root_key,
+                         periods)
+        return self.state
+
+    def step_once(self, rnd: PeriodRandomness | None = None) -> DenseState:
+        if rnd is None:
+            rnd = draw_period(self.root_key, self.state.step, self.cfg)
+        self.state = self._step(self.state, self.plan, rnd)
+        return self.state

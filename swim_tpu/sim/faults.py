@@ -1,0 +1,110 @@
+"""Fault injection as tensors (BASELINE.md configs 2–5).
+
+The reference injects failures by killing live demo nodes; a vectorized
+simulator instead expresses the whole fault schedule as data:
+
+  * crash-stop: `crash_step[N]` — the period at which a node halts forever
+    (INT32_MAX = never). Crashed nodes neither send nor receive.
+  * packet loss: global Bernoulli `loss` probability, applied independently
+    per directed message (every message wave draws its own uniforms).
+  * partition: `partition_id[N]` group labels; between `partition_start` and
+    `partition_end` (half-open, in periods) messages between different
+    groups are dropped.
+
+Everything here is a *runtime* value — sweeps over loss rates, crash
+schedules, or partition windows reuse a single compiled step (the engines
+take FaultPlan as a traced argument).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEVER = np.int32(2**31 - 1)
+
+
+class FaultPlan(NamedTuple):
+    crash_step: jax.Array       # i32[N], NEVER = no crash
+    loss: jax.Array             # f32 scalar in [0, 1)
+    partition_id: jax.Array     # i32[N] group labels
+    partition_start: jax.Array  # i32 scalar (period, inclusive)
+    partition_end: jax.Array    # i32 scalar (period, exclusive)
+
+
+def none(n: int) -> FaultPlan:
+    """A perfect network: no crashes, no loss, no partition."""
+    return FaultPlan(
+        crash_step=jnp.full((n,), NEVER, jnp.int32),
+        loss=jnp.float32(0.0),
+        partition_id=jnp.zeros((n,), jnp.int32),
+        partition_start=jnp.int32(0),
+        partition_end=jnp.int32(0),
+    )
+
+
+def with_loss(plan: FaultPlan, loss: float) -> FaultPlan:
+    return plan._replace(loss=jnp.float32(loss))
+
+
+def with_crashes(plan: FaultPlan, node_ids, at_step) -> FaultPlan:
+    """Crash the given nodes at the given period(s)."""
+    node_ids = jnp.asarray(node_ids, jnp.int32)
+    at = jnp.broadcast_to(jnp.asarray(at_step, jnp.int32), node_ids.shape)
+    return plan._replace(
+        crash_step=plan.crash_step.at[node_ids].min(at))
+
+
+def with_random_crashes(plan: FaultPlan, key: jax.Array, fraction: float,
+                        start: int, end: int) -> FaultPlan:
+    """Crash ~`fraction` of nodes, each at a uniform period in [start, end).
+
+    The spread-out (rather than burst) schedule is the default for the
+    1k-node detection-time study (BASELINE.md config 2, "1% random
+    crash-stop injection"); pass start == end - 1 for a burst.
+    """
+    n = plan.crash_step.shape[0]
+    k_pick, k_when = jax.random.split(key)
+    hit = jax.random.uniform(k_pick, (n,)) < fraction
+    when = jax.random.randint(k_when, (n,), start, max(end, start + 1))
+    return plan._replace(
+        crash_step=jnp.where(hit, jnp.minimum(plan.crash_step, when),
+                             plan.crash_step).astype(jnp.int32))
+
+
+def with_partition(plan: FaultPlan, group_of, start: int,
+                   end: int) -> FaultPlan:
+    """Two-or-more-way partition over [start, end) periods.
+
+    `group_of` is an i32[N] label array (e.g. halves for the 2-way split of
+    BASELINE.md config 3).
+    """
+    return plan._replace(
+        partition_id=jnp.asarray(group_of, jnp.int32),
+        partition_start=jnp.int32(start),
+        partition_end=jnp.int32(end),
+    )
+
+
+def halves(n: int) -> np.ndarray:
+    """Label array for a 2-way even split."""
+    g = np.zeros((n,), np.int32)
+    g[n // 2:] = 1
+    return g
+
+
+def crashed_mask(plan: FaultPlan, step) -> jax.Array:
+    """bool[N]: which nodes have crash-stopped by period `step`."""
+    return jnp.asarray(step, jnp.int32) >= plan.crash_step
+
+
+def partition_active(plan: FaultPlan, step) -> jax.Array:
+    s = jnp.asarray(step, jnp.int32)
+    return (s >= plan.partition_start) & (s < plan.partition_end)
+
+
+def to_numpy(plan: FaultPlan) -> FaultPlan:
+    return FaultPlan(*(np.asarray(x) for x in plan))

@@ -1,0 +1,76 @@
+"""Benchmark: simulated protocol-periods/sec (BASELINE.md primary metric).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The north-star target (BASELINE.json) is 10,000 protocol-periods/sec at 1M
+virtual nodes on a v5e-8. `vs_baseline` reports value / 10_000 — i.e. the
+fraction of that target achieved on the hardware this run sees, at the
+largest configuration it can hold.
+
+Run with --smoke for a fast correctness pass (small N, few periods).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+
+TARGET_PERIODS_PER_SEC = 10_000.0
+
+
+def bench_dense(n_nodes: int, periods: int, warmup: int = 2) -> float:
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import dense
+    from swim_tpu.parallel import mesh as pmesh
+    from swim_tpu.sim import faults
+
+    cfg = SwimConfig(n_nodes=n_nodes)
+    mesh = pmesh.make_mesh()
+    state = pmesh.shard_state(dense.init_state(cfg), mesh)
+    plan = faults.with_random_crashes(
+        faults.none(n_nodes), jax.random.key(1), 0.01, 0, max(periods, 1))
+    plan = pmesh.shard_state(plan, mesh)
+    key = jax.random.key(0)
+
+    run = jax.jit(
+        lambda st: dense.run(cfg, st, plan, key, periods),
+        out_shardings=pmesh.state_shardings(state, mesh),
+    )
+    for _ in range(warmup):
+        jax.block_until_ready(run(state))
+    t0 = time.perf_counter()
+    out = run(state)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return periods / dt
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--nodes", type=int, default=0)
+    ap.add_argument("--periods", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.smoke:
+        n, periods = 128, 16
+    else:
+        n = args.nodes or 4096
+        periods = args.periods or 200
+
+    pps = bench_dense(n, periods)
+    print(json.dumps({
+        "metric": f"simulated protocol-periods/sec @ {n} nodes (dense engine)",
+        "value": round(pps, 2),
+        "unit": "periods/sec",
+        "vs_baseline": round(pps / TARGET_PERIODS_PER_SEC, 4),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

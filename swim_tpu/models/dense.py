@@ -78,7 +78,10 @@ def _piggyback(cfg: SwimConfig, retransmit: jax.Array):
 
     Returns (sel_idx i32[N, B], sel_valid bool[N, B]).
     """
-    n, b = cfg.n_nodes, cfg.max_piggyback
+    # Width min(B, N) is exact: a sender can never piggyback more than N
+    # distinct subjects, and when a buddy-forced subject is absent from the
+    # selection at most N-1 of these slots can be valid.
+    n, b = cfg.n_nodes, min(cfg.max_piggyback, cfg.n_nodes)
     j_ids = jnp.arange(n, dtype=jnp.int32)
     rank = retransmit * jnp.int32(n + 1) + j_ids[None, :]
     rank = jnp.where(retransmit < cfg.retransmit_limit, rank, _RANK_INF)
@@ -133,13 +136,16 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
                          axis=-1).astype(jnp.int32)    # i32[N, k]
     has_proxy = c2 > 0
 
-    susp_key_row = lattice.is_suspect(key)             # for buddy forcing
+    def buddy(cur_key, src, dst):
+        """forced subject per message: dst if src believes dst SUSPECT.
 
-    def buddy(src, dst):
-        """forced subject per message: dst if src believes dst SUSPECT."""
+        Evaluated against the *current* view at wave-build time (the oracle
+        reads live state when constructing each wave's message list).
+        """
         if not (cfg.lifeguard and cfg.buddy):
             return jnp.full(src.shape, -1, jnp.int32)
-        return jnp.where(susp_key_row[src, dst], dst, jnp.int32(-1))
+        return jnp.where(lattice.is_suspect(cur_key[src, dst]), dst,
+                         jnp.int32(-1))
 
     def wave(carry, src, dst, sent, u_loss, forced):
         """Run one message wave; returns updated carry and delivered mask.
@@ -174,7 +180,7 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
 
     # W1: pings i → T(i)
     carry, w1_ok = wave(carry, ids, target, prober, rnd.loss_w1,
-                        buddy(ids, target))
+                        buddy(carry[0], ids, target))
     # W2: acks T(i) → i (one per delivered ping, indexed by pinger i)
     no_force = jnp.full((n,), -1, jnp.int32)
     carry, w2_ok = wave(carry, target, ids, w1_ok, rnd.loss_w2, no_force)
@@ -189,7 +195,7 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     # W4: proxy pings p → T(i)
     tgt4 = jnp.repeat(target, k)
     carry, w4_ok = wave(carry, dst3, tgt4, w3_ok, rnd.loss_w4.reshape(-1),
-                        buddy(dst3, tgt4))
+                        buddy(carry[0], dst3, tgt4))
     # W5: target acks T(i) → p
     carry, w5_ok = wave(carry, tgt4, dst3, w4_ok, rnd.loss_w5.reshape(-1),
                         jnp.full((n * k,), -1, jnp.int32))

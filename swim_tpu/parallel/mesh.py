@@ -1,0 +1,68 @@
+"""Device mesh construction and shardings for the node axis.
+
+The simulator's parallelism is 1-D data parallelism over *virtual nodes*
+(SURVEY.md §2): every per-node tensor shards its leading N axis across the
+mesh; [N, N] view tensors shard rows (each chip owns its nodes' views, the
+column axis stays logical). Message delivery then becomes gather (read
+sender rows, local) + scatter (write receiver rows, cross-shard) — XLA's
+GSPMD partitioner lowers the cross-shard scatters onto ICI collectives
+(all-to-all / collective-permute) without any hand-written NCCL-style code,
+which is the TPU-native analog of the reference's socket transport fan-out.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: int | None = None,
+              devices: list | None = None) -> Mesh:
+    """1-D mesh over the node axis. Default: all available devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def node_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Shard the leading (node) axis; replicate everything else."""
+    return NamedSharding(mesh, P(NODE_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a per-node-leading-axis state pytree onto the mesh.
+
+    Arrays whose leading dim equals the (global) node count shard on it;
+    scalars replicate. Works for DenseState, RumorState, and FaultPlan.
+    """
+    n = max((x.shape[0] for x in jax.tree.leaves(state)
+             if getattr(x, "ndim", 0) >= 1), default=None)
+
+    def place(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n:
+            return jax.device_put(x, node_sharding(mesh, x.ndim))
+        return jax.device_put(x, replicated(mesh))
+
+    return jax.tree.map(place, state)
+
+
+def state_shardings(state, mesh: Mesh):
+    """The NamedSharding pytree matching `shard_state` (for jit donation)."""
+    n = max((x.shape[0] for x in jax.tree.leaves(state)
+             if getattr(x, "ndim", 0) >= 1), default=None)
+
+    def spec(x):
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n:
+            return node_sharding(mesh, x.ndim)
+        return replicated(mesh)
+
+    return jax.tree.map(spec, state)

@@ -7,7 +7,6 @@ Real-TPU benchmarking happens in bench.py, not here.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,4 +15,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402  (import after env setup, on purpose)
 
+# The sandbox's sitecustomize pins JAX_PLATFORMS=axon (the real TPU tunnel);
+# the config override below wins regardless, putting tests on the 8-device
+# virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_virtual_mesh():
+    assert len(jax.devices()) == 8, jax.devices()
+    yield

@@ -1,0 +1,108 @@
+"""Bitwise equivalence: dense JAX engine ⟷ scalar oracle.
+
+Both consume identical PeriodRandomness tensors, so every field of the state
+must match exactly, period by period — any semantic drift in the vectorized
+engine shows up as the first differing period. Scenarios cover the stock
+demo, crashes, loss, partitions, and Lifeguard.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import dense, oracle
+from swim_tpu.sim import faults
+from swim_tpu.utils import prng
+
+
+def run_both(cfg, plan, seed, periods):
+    o = oracle.Oracle(cfg, plan)
+    e_state = dense.init_state(cfg)
+    step = jax.jit(lambda st, r: dense.step(cfg, st, plan, r))
+    key = jax.random.key(seed)
+    for t in range(periods):
+        rnd = prng.draw_period(key, t, cfg)
+        o.step(prng.to_numpy(rnd))
+        e_state = step(e_state, rnd)
+        for name in ("key", "retransmit", "deadline", "lha"):
+            a = np.asarray(getattr(e_state, name))
+            b = np.asarray(getattr(o.state, name))
+            if not np.array_equal(a, b):
+                bad = np.argwhere(a != b)[:8]
+                raise AssertionError(
+                    f"{name} diverged at period {t}; first diffs at "
+                    f"{bad.tolist()}: engine={a[tuple(bad[0])]} "
+                    f"oracle={b[tuple(bad[0])]}")
+    return o, e_state
+
+
+def test_quiet_cluster():
+    cfg = SwimConfig(n_nodes=16)
+    run_both(cfg, faults.none(16), seed=0, periods=8)
+
+
+def test_stock_demo_with_crashes():
+    """32-node stock demo config; two crashes at different times."""
+    cfg = SwimConfig(n_nodes=32, suspicion_mult=2.0)
+    plan = faults.with_crashes(faults.none(32), [3, 17], [0, 4])
+    run_both(cfg, plan, seed=1, periods=20)
+
+
+def test_lossy_network():
+    cfg = SwimConfig(n_nodes=20, suspicion_mult=2.0)
+    plan = faults.with_loss(faults.none(20), 0.3)
+    run_both(cfg, plan, seed=2, periods=16)
+
+
+def test_partition_heals():
+    cfg = SwimConfig(n_nodes=18, suspicion_mult=3.0)
+    plan = faults.with_partition(faults.none(18), faults.halves(18), 2, 9)
+    run_both(cfg, plan, seed=3, periods=18)
+
+
+def test_everything_at_once():
+    """Loss + partition + crashes together, long enough for deaths+refutes."""
+    cfg = SwimConfig(n_nodes=24, suspicion_mult=1.5)
+    plan = faults.none(24)
+    plan = faults.with_loss(plan, 0.15)
+    plan = faults.with_crashes(plan, [1, 2], [2, 6])
+    plan = faults.with_partition(plan, faults.halves(24), 4, 10)
+    run_both(cfg, plan, seed=4, periods=24)
+
+
+def test_lifeguard_parity():
+    """LHA thinning + buddy forcing must match scalar semantics exactly."""
+    cfg = SwimConfig(n_nodes=20, suspicion_mult=2.0, lifeguard=True)
+    plan = faults.with_loss(faults.none(20), 0.25)
+    plan = faults.with_crashes(plan, [5], [3])
+    run_both(cfg, plan, seed=5, periods=18)
+
+
+def test_tiny_cluster_edges():
+    """n=2,3: empty candidate sets, no proxies available."""
+    for n, seed in ((2, 6), (3, 7)):
+        cfg = SwimConfig(n_nodes=n, suspicion_mult=1.0)
+        plan = faults.with_crashes(faults.none(n), [0], [1])
+        run_both(cfg, plan, seed=seed, periods=10)
+
+
+def test_piggyback_wider_than_cluster():
+    """B > N exercises the min(B, N) selection clamp, with buddy forcing."""
+    cfg = SwimConfig(n_nodes=4, suspicion_mult=2.0, lifeguard=True)
+    plan = faults.with_loss(faults.none(4), 0.3)
+    run_both(cfg, plan, seed=9, periods=14)
+
+
+def test_scan_run_matches_python_loop():
+    """dense.run (lax.scan over fused periods) ≡ stepping one at a time."""
+    cfg = SwimConfig(n_nodes=16, suspicion_mult=2.0)
+    plan = faults.with_crashes(faults.none(16), [4], [0])
+    key = jax.random.key(8)
+    st_loop = dense.init_state(cfg)
+    step = jax.jit(lambda st, r: dense.step(cfg, st, plan, r))
+    for t in range(12):
+        st_loop = step(st_loop, prng.draw_period(key, t, cfg))
+    st_scan = dense.run(cfg, dense.init_state(cfg), plan, key, 12)
+    for a, b in zip(st_scan, st_loop):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -1,8 +1,12 @@
 """swim-tpu command-line interface.
 
-Mirrors the reference's demo executable (stock config: 32-node in-process
-cluster, k=3, 1 s period — BASELINE.json configs[0]) and fronts the
-simulators. Subcommands grow with the framework; `info` is always available.
+Subcommands:
+  info      — derived protocol constants for a given cluster size
+  demo      — the reference's stock demo: an N-node in-process cluster
+              (default 32, k=3, 1 s period) on deterministic virtual time,
+              with optional kills, loss, and partition injection
+  simulate  — the vectorized TPU engine: N up to millions, faults as
+              tensors, metrics as JSON
 """
 
 from __future__ import annotations
@@ -29,6 +33,104 @@ def _cmd_info(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_demo(args: argparse.Namespace) -> int:
+    from swim_tpu import SwimConfig, Status
+    from swim_tpu.core.cluster import SimCluster
+
+    cfg = SwimConfig(n_nodes=args.nodes, lifeguard=args.lifeguard)
+    cluster = SimCluster(cfg, seed=args.seed, loss=args.loss)
+
+    events = []
+    for node in cluster.nodes:
+        def listener(member, old, new, _id=node.id):
+            if old is not None and old.status != new.status:
+                events.append((cluster.clock.now(), _id, member,
+                               new.status.name, new.incarnation))
+        node.members.listeners.append(listener)
+
+    cluster.start()
+    cluster.run(args.settle)
+    print(f"# {args.nodes}-node in-process cluster converged "
+          f"(k={cfg.k_indirect}, period={cfg.protocol_period}s, "
+          f"seed={args.seed}, loss={args.loss})")
+
+    for victim in args.kill:
+        print(f"# t={cluster.clock.now():.1f}s: killing node {victim}")
+        cluster.kill(victim)
+    cluster.run(args.duration)
+
+    if not args.quiet:
+        for t, observer, member, status, inc in events[-args.tail:]:
+            print(f"t={t:7.2f}s  node{observer:<4d} sees node{member:<4d} "
+                  f"{status}@{inc}")
+    live = [i for i in range(args.nodes) if i not in set(args.kill)]
+    summary = {
+        "sim_seconds": round(cluster.clock.now(), 2),
+        "messages_sent": cluster.network.sent,
+        "messages_delivered": cluster.network.delivered,
+        "status_transitions": len(events),
+        "killed": args.kill,
+        "all_kills_detected_everywhere": all(
+            cluster.all_consider(v, Status.DEAD, among=live)
+            for v in args.kill),
+        "false_deaths": sum(
+            1 for m in live for i in live
+            if cluster.nodes[i].members.opinion(m).status == Status.DEAD),
+        "refutations": sum(n.stats["refutations"] for n in cluster.nodes),
+    }
+    print(json.dumps(summary))
+    return 0 if (summary["all_kills_detected_everywhere"] or not args.kill) \
+        else 1
+
+
+def _cmd_simulate(args: argparse.Namespace) -> int:
+    import jax
+    import numpy as np
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import dense
+    from swim_tpu.ops import lattice
+    from swim_tpu.parallel import mesh as pmesh
+    from swim_tpu.sim import faults
+
+    cfg = SwimConfig(n_nodes=args.nodes, suspicion_mult=args.suspicion_mult,
+                     lifeguard=args.lifeguard)
+    plan = faults.none(args.nodes)
+    if args.loss:
+        plan = faults.with_loss(plan, args.loss)
+    if args.crash_fraction:
+        plan = faults.with_random_crashes(
+            plan, jax.random.key(args.seed + 1), args.crash_fraction,
+            0, max(1, args.periods // 2))
+    mesh = pmesh.make_mesh()
+    state = pmesh.shard_state(dense.init_state(cfg), mesh)
+    plan = pmesh.shard_state(plan, mesh)
+    import time
+    t0 = time.perf_counter()
+    state = dense.run(cfg, state, plan, jax.random.key(args.seed),
+                      args.periods)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    crashed = np.asarray(plan.crash_step) <= args.periods
+    keys = np.asarray(state.key)
+    dead_views = np.asarray(lattice.is_dead(keys))
+    live = ~crashed
+    detected = (dead_views[np.ix_(live, crashed)].all(axis=0).sum()
+                if crashed.any() else 0)
+    print(json.dumps({
+        "nodes": args.nodes,
+        "periods": args.periods,
+        "seconds": round(dt, 3),
+        "periods_per_sec": round(args.periods / dt, 2),
+        "crashed": int(crashed.sum()),
+        "crashed_detected_by_all_live": int(detected),
+        "false_deaths": int(dead_views[np.ix_(live, live)].sum()),
+        "devices": len(jax.devices()),
+    }))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="swim-tpu",
@@ -39,6 +141,33 @@ def build_parser() -> argparse.ArgumentParser:
     info = sub.add_parser("info", help="show derived protocol constants")
     info.add_argument("--nodes", type=int, default=32)
     info.set_defaults(fn=_cmd_info)
+
+    demo = sub.add_parser(
+        "demo", help="N-node in-process cluster (the reference's stock demo)")
+    demo.add_argument("--nodes", type=int, default=32)
+    demo.add_argument("--seed", type=int, default=0)
+    demo.add_argument("--loss", type=float, default=0.0)
+    demo.add_argument("--kill", type=int, nargs="*", default=[],
+                      help="node ids to crash after settling")
+    demo.add_argument("--settle", type=float, default=10.0,
+                      help="seconds of sim time before injecting kills")
+    demo.add_argument("--duration", type=float, default=30.0,
+                      help="seconds of sim time after kills")
+    demo.add_argument("--lifeguard", action="store_true")
+    demo.add_argument("--tail", type=int, default=20,
+                      help="show the last K status transitions")
+    demo.add_argument("--quiet", action="store_true")
+    demo.set_defaults(fn=_cmd_demo)
+
+    sim = sub.add_parser("simulate", help="vectorized TPU simulation")
+    sim.add_argument("--nodes", type=int, default=1024)
+    sim.add_argument("--periods", type=int, default=100)
+    sim.add_argument("--seed", type=int, default=0)
+    sim.add_argument("--loss", type=float, default=0.0)
+    sim.add_argument("--crash-fraction", type=float, default=0.01)
+    sim.add_argument("--suspicion-mult", type=float, default=5.0)
+    sim.add_argument("--lifeguard", action="store_true")
+    sim.set_defaults(fn=_cmd_simulate)
     return p
 
 

@@ -21,6 +21,12 @@ import dataclasses
 import math
 
 
+def log_n_of(n: int) -> float:
+    """The protocol's log-scaling base: log10 of the cluster size, floored
+    at 1 (shared by static configs and live-cluster sizing)."""
+    return max(1.0, math.log10(max(n, 10)))
+
+
 @dataclasses.dataclass(frozen=True)
 class SwimConfig:
     """Static protocol constants (one compiled step per distinct config).
@@ -48,9 +54,11 @@ class SwimConfig:
     lifeguard: bool = False      # master switch (config 5 vs vanilla SWIM)
     lha_max: int = 8             # local-health-aware probe: max health score S;
     #                              probe timeout scales by (1 + S/lha_max).
-    dynamic_suspicion: bool = True   # suspicion timeout shrinks with
-    #                                  independent confirmations
-    suspicion_min_mult: float = 1.0  # floor of the dynamic suspicion timeout
+    dynamic_suspicion: bool = True   # start at suspicion_max_mult × the
+    #                                  vanilla timeout, shrink toward the
+    #                                  vanilla floor as independent
+    #                                  confirmations arrive
+    suspicion_max_mult: float = 2.0  # ceiling multiplier (memberlist: 6)
     buddy: bool = True           # buddy system: prioritize telling a suspect
     #                              it is suspected so it can refute fast
     # --- engine capacity knobs (rumor engine only) ---
@@ -67,7 +75,7 @@ class SwimConfig:
 
     @property
     def log_n(self) -> float:
-        return max(1.0, math.log10(max(self.n_nodes, 10)))
+        return log_n_of(self.n_nodes)
 
     @property
     def retransmit_limit(self) -> int:
@@ -84,9 +92,10 @@ class SwimConfig:
         return max(1, math.ceil(self.suspicion_mult * self.log_n))
 
     @property
-    def suspicion_min_periods(self) -> int:
-        """Lifeguard dynamic-suspicion floor, in protocol periods."""
-        return max(1, math.ceil(self.suspicion_min_mult * self.log_n))
+    def suspicion_max_periods(self) -> int:
+        """Lifeguard dynamic-suspicion ceiling, in protocol periods."""
+        return max(1, math.ceil(self.suspicion_mult * self.suspicion_max_mult
+                                * self.log_n))
 
     @property
     def gossip_window(self) -> int:

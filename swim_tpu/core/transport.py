@@ -1,0 +1,184 @@
+"""Transport abstraction — the extension seam of the framework.
+
+The reference abstracts its wire behind the `Swim.Transport` typeclass
+(SURVEY.md §1: send/receive of protocol messages; instances for real
+sockets and the in-process 32-node demo). swim_tpu mirrors that seam as an
+ABC with three implementations:
+
+  * `InProcessTransport` — deterministic in-memory network for multi-node
+    runs in one process (the demo/test fixture), with injectable loss,
+    partitions, and per-link latency driven by a `SimClock`.
+  * `UDPTransport` — asyncio datagram transport for real clusters.
+  * `TPUSimTransport` (swim_tpu/bridge) — the north-star backend: messages
+    delivered into the vectorized TPU simulation.
+
+Addresses are opaque `(host, port)` tuples; the in-process network uses
+("sim", node_id).
+"""
+
+from __future__ import annotations
+
+import abc
+import random
+from typing import Callable
+
+from swim_tpu.core.clock import Clock, SimClock
+
+Address = tuple[str, int]
+Receiver = Callable[[Address, bytes], None]
+
+
+class Transport(abc.ABC):
+    """Datagram-style message transport (unreliable, unordered is allowed)."""
+
+    @abc.abstractmethod
+    def send(self, to: Address, payload: bytes) -> None:
+        """Fire-and-forget send; loss is legal and expected."""
+
+    @abc.abstractmethod
+    def set_receiver(self, receiver: Receiver) -> None:
+        """Register the inbound-message callback (sender address, payload)."""
+
+    @property
+    @abc.abstractmethod
+    def local_address(self) -> Address:
+        ...
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+
+class SimNetwork:
+    """Shared medium for InProcessTransport endpoints.
+
+    Delivery is scheduled on the SimClock (default latency 1 ms), so message
+    interleavings are deterministic given the seed — the reference's
+    in-process cluster pattern made reproducible.
+    """
+
+    def __init__(self, clock: SimClock, seed: int = 0, loss: float = 0.0,
+                 latency: float = 0.001):
+        self.clock = clock
+        self.rng = random.Random(seed)
+        self.loss = loss
+        self.latency = latency
+        self._endpoints: dict[Address, "InProcessTransport"] = {}
+        self._cut: set[frozenset[Address]] = set()
+        self._down: set[Address] = set()
+        self.sent = 0
+        self.delivered = 0
+
+    def attach(self, ep: "InProcessTransport") -> None:
+        self._endpoints[ep.local_address] = ep
+
+    # -- fault injection ----------------------------------------------------
+
+    def set_loss(self, loss: float) -> None:
+        self.loss = loss
+
+    def cut(self, a: Address, b: Address) -> None:
+        self._cut.add(frozenset((a, b)))
+
+    def heal(self, a: Address, b: Address) -> None:
+        self._cut.discard(frozenset((a, b)))
+
+    def partition(self, group_a: list[Address], group_b: list[Address]):
+        for a in group_a:
+            for b in group_b:
+                self.cut(a, b)
+
+    def heal_all(self) -> None:
+        self._cut.clear()
+
+    def kill(self, addr: Address) -> None:
+        """Crash-stop a node: its endpoint neither sends nor receives."""
+        self._down.add(addr)
+
+    # -- delivery -----------------------------------------------------------
+
+    def transmit(self, src: Address, dst: Address, payload: bytes) -> None:
+        self.sent += 1
+        if src in self._down or dst in self._down:
+            return
+        if frozenset((src, dst)) in self._cut:
+            return
+        if self.loss and self.rng.random() < self.loss:
+            return
+        ep = self._endpoints.get(dst)
+        if ep is None:
+            return
+
+        def deliver():
+            if dst in self._down:
+                return
+            self.delivered += 1
+            if ep._receiver is not None:
+                ep._receiver(src, payload)
+
+        self.clock.call_later(self.latency, deliver)
+
+
+class InProcessTransport(Transport):
+    """Loopback transport instance backing multi-node single-process runs."""
+
+    def __init__(self, network: SimNetwork, node_id: int):
+        self._network = network
+        self._addr: Address = ("sim", node_id)
+        self._receiver: Receiver | None = None
+        network.attach(self)
+
+    def send(self, to: Address, payload: bytes) -> None:
+        self._network.transmit(self._addr, to, payload)
+
+    def set_receiver(self, receiver: Receiver) -> None:
+        self._receiver = receiver
+
+    @property
+    def local_address(self) -> Address:
+        return self._addr
+
+
+class UDPTransport(Transport):
+    """Real-network instance over asyncio UDP datagrams.
+
+    Create with `await UDPTransport.create(host, port)` inside a running
+    loop; pairs with core.clock.AsyncioClock.
+    """
+
+    def __init__(self, transport, local: Address):
+        self._transport = transport
+        self._local = local
+        self._receiver: Receiver | None = None
+
+    @classmethod
+    async def create(cls, host: str = "127.0.0.1", port: int = 0):
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        self_holder: dict = {}
+
+        class _Proto(asyncio.DatagramProtocol):
+            def datagram_received(_, data: bytes, addr):
+                t = self_holder.get("t")
+                if t is not None and t._receiver is not None:
+                    t._receiver((addr[0], addr[1]), data)
+
+        transport, _ = await loop.create_datagram_endpoint(
+            _Proto, local_addr=(host, port))
+        sock = transport.get_extra_info("sockname")
+        t = cls(transport, (sock[0], sock[1]))
+        self_holder["t"] = t
+        return t
+
+    def send(self, to: Address, payload: bytes) -> None:
+        self._transport.sendto(payload, to)
+
+    def set_receiver(self, receiver: Receiver) -> None:
+        self._receiver = receiver
+
+    @property
+    def local_address(self) -> Address:
+        return self._local
+
+    def close(self) -> None:
+        self._transport.close()

@@ -1,0 +1,137 @@
+"""Study runner: simulation with on-device metric collection.
+
+Collects, inside the same lax.scan that advances the protocol, the
+quantities BASELINE.md's studies need (configs 2–5):
+
+  * first-detection step per crashed node (suspicion reaching any live node)
+    and first-death-view step → detection-time distributions (the SWIM
+    paper's e/(e−1) curve),
+  * dissemination-completion step per crashed node (all live nodes hold the
+    DEAD view),
+  * per-period global counters (suspect views, dead views, refutations seen
+    as incarnation bumps, false-death views) — psum-style full reductions
+    that stay on device; only O(periods) scalars ever reach the host.
+
+Works on the dense engine state; the rumor engine provides its own cheaper
+collectors (its state already *is* event-shaped).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.models import dense
+from swim_tpu.ops import lattice
+from swim_tpu.sim.faults import FaultPlan
+from swim_tpu.utils.prng import draw_period
+
+NEVER = jnp.int32(2**31 - 1)
+
+
+class StudyTrack(NamedTuple):
+    """Per-crashed-node detection milestones (i32[N], NEVER = not yet)."""
+
+    first_suspect: jax.Array   # some live node stops believing ALIVE
+    first_dead_view: jax.Array  # some live node holds DEAD
+    disseminated: jax.Array    # all live nodes hold DEAD
+
+
+class PeriodSeries(NamedTuple):
+    """Per-period global counters (i32[periods])."""
+
+    suspect_views: jax.Array
+    dead_views: jax.Array
+    false_dead_views: jax.Array
+    max_incarnation: jax.Array
+
+
+class StudyResult(NamedTuple):
+    state: dense.DenseState
+    track: StudyTrack
+    series: PeriodSeries
+
+
+def _update_track(track: StudyTrack, state: dense.DenseState,
+                  crashed: jax.Array, t: jax.Array) -> StudyTrack:
+    key = state.key
+    live = ~crashed
+    not_alive_view = lattice.is_suspect(key) | lattice.is_dead(key)
+    dead_view = lattice.is_dead(key)
+    live_col = live[:, None]
+    any_suspect = jnp.any(not_alive_view & live_col, axis=0)
+    any_dead = jnp.any(dead_view & live_col, axis=0)
+    all_dead = jnp.all(dead_view | ~live_col, axis=0)
+
+    def first(cur, cond):
+        hit = cond & crashed & (cur == NEVER)
+        return jnp.where(hit, t, cur)
+
+    return StudyTrack(
+        first_suspect=first(track.first_suspect, any_suspect),
+        first_dead_view=first(track.first_dead_view, any_dead),
+        disseminated=first(track.disseminated, all_dead),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
+              root_key: jax.Array, periods: int) -> StudyResult:
+    n = cfg.n_nodes
+    track0 = StudyTrack(*(jnp.full((n,), NEVER, jnp.int32)
+                          for _ in range(3)))
+
+    def body(carry, _):
+        st, track = carry
+        rnd = draw_period(root_key, st.step, cfg)
+        st = dense.step(cfg, st, plan, rnd)
+        # metrics observe the post-step state at time st.step - 1 = the
+        # period just executed
+        t = st.step - 1
+        crashed = t >= plan.crash_step
+        track = _update_track(track, st, crashed, t)
+        live_col = (~crashed)[:, None]
+        live_row = (~crashed)[None, :]
+        susp = lattice.is_suspect(st.key)
+        dead = lattice.is_dead(st.key)
+        series = (
+            jnp.sum(susp & live_col).astype(jnp.int32),
+            jnp.sum(dead & live_col).astype(jnp.int32),
+            jnp.sum(dead & live_col & live_row).astype(jnp.int32),
+            jnp.max(lattice.incarnation_of(st.key)).astype(jnp.int32),
+        )
+        return (st, track), series
+
+    (state, track), series = jax.lax.scan(body, (state, track0), None,
+                                          length=periods)
+    return StudyResult(state, track, PeriodSeries(*series))
+
+
+def detection_summary(result: StudyResult, plan: FaultPlan,
+                      periods: int) -> dict:
+    """Host-side digest: detection-latency distribution in periods."""
+    crash = np.asarray(plan.crash_step)
+    crashed = crash < periods
+    out = {"crashed": int(crashed.sum())}
+    if not crashed.any():
+        return out
+    for name, arr in (("suspect", result.track.first_suspect),
+                      ("dead_view", result.track.first_dead_view),
+                      ("disseminated", result.track.disseminated)):
+        arr = np.asarray(arr)
+        lat = arr[crashed].astype(np.int64) - crash[crashed]
+        ok = arr[crashed] != int(NEVER)
+        out[f"{name}_detected"] = int(ok.sum())
+        if ok.any():
+            lat_ok = lat[ok] + 1  # period t event ⇒ latency in (0, t+1]
+            out[f"{name}_latency_mean"] = float(lat_ok.mean())
+            out[f"{name}_latency_p50"] = float(np.percentile(lat_ok, 50))
+            out[f"{name}_latency_p99"] = float(np.percentile(lat_ok, 99))
+    out["false_dead_views_final"] = int(
+        np.asarray(result.series.false_dead_views)[-1])
+    return out

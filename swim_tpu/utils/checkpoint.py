@@ -1,0 +1,89 @@
+"""Checkpoint / resume for long simulations.
+
+Because per-period randomness is derived (`fold_in(root_key, step)` — see
+utils/prng.py), a checkpoint is just {state tensors, root key data}: resuming
+from period t reproduces the exact trajectory the uninterrupted run would
+have taken. Stored as a single .npz (portable, no framework lock-in);
+`CheckpointManager` rotates every-K-period snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _flatten(tree: Any) -> dict[str, np.ndarray]:
+    leaves, treedef = jax.tree.flatten(tree)
+    return {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+
+
+def save(path: str, state: Any, root_key: jax.Array, step: int) -> None:
+    payload = _flatten(state)
+    payload["__key_data"] = np.asarray(jax.random.key_data(root_key))
+    payload["__step"] = np.asarray(step, np.int64)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+
+
+def restore(path: str, state_like: Any) -> tuple[Any, jax.Array, int]:
+    """Returns (state, root_key, step). `state_like` supplies the pytree
+    structure (e.g. a freshly built init_state of the same config)."""
+    with np.load(path) as z:
+        leaves, treedef = jax.tree.flatten(state_like)
+        if len(leaves) != sum(1 for k in z.files if k.startswith("leaf_")):
+            raise ValueError(
+                "checkpoint layout does not match the provided state "
+                "structure (different config or engine?)")
+        new_leaves = [jnp_like(z[f"leaf_{i}"], leaves[i])
+                      for i in range(len(leaves))]
+        state = jax.tree.unflatten(treedef, new_leaves)
+        root_key = jax.random.wrap_key_data(z["__key_data"])
+        step = int(z["__step"])
+    return state, root_key, step
+
+
+def jnp_like(arr: np.ndarray, like) -> jax.Array:
+    import jax.numpy as jnp
+
+    out = jnp.asarray(arr)
+    if hasattr(like, "dtype") and out.dtype != like.dtype:
+        raise ValueError(f"dtype mismatch: {out.dtype} vs {like.dtype}")
+    if hasattr(like, "shape") and tuple(out.shape) != tuple(like.shape):
+        raise ValueError(f"shape mismatch: {out.shape} vs {like.shape} "
+                         "(checkpoint from a different config?)")
+    return out
+
+
+class CheckpointManager:
+    """Every-K-period snapshots with bounded retention."""
+
+    def __init__(self, directory: str, every: int, keep: int = 3):
+        self.directory = directory
+        self.every = every
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def maybe_save(self, state: Any, root_key: jax.Array, step: int) -> bool:
+        if step == 0 or step % self.every:
+            return False
+        save(os.path.join(self.directory, f"ckpt_{step:012d}.npz"),
+             state, root_key, step)
+        self._gc()
+        return True
+
+    def latest(self) -> str | None:
+        snaps = sorted(f for f in os.listdir(self.directory)
+                       if f.startswith("ckpt_") and f.endswith(".npz"))
+        return os.path.join(self.directory, snaps[-1]) if snaps else None
+
+    def _gc(self) -> None:
+        snaps = sorted(f for f in os.listdir(self.directory)
+                       if f.startswith("ckpt_") and f.endswith(".npz"))
+        for f in snaps[:-self.keep]:
+            os.remove(os.path.join(self.directory, f))

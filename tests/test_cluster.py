@@ -1,0 +1,156 @@
+"""End-to-end in-process cluster tests — the reference demo as a fixture.
+
+Deterministic multi-node runs over SimNetwork/SimClock (SURVEY.md §4's
+in-process pattern): detection, dissemination, refutation, join, Lifeguard.
+"""
+
+import pytest
+
+from swim_tpu import SwimConfig, Status
+from swim_tpu.core.cluster import SimCluster
+from swim_tpu.core.node import Node
+from swim_tpu.core.transport import InProcessTransport
+
+
+def stock(n=32, **kw):
+    return SwimConfig(n_nodes=n, k_indirect=3, protocol_period=1.0, **kw)
+
+
+def test_quiet_cluster_stays_alive():
+    c = SimCluster(stock(16), seed=0)
+    c.start()
+    c.run(20.0)
+    assert c.converged_all_alive()
+    # constant per-node message load (SWIM's key property): ~2 msgs per
+    # period per node (ping+ack), no indirect traffic in a healthy cluster
+    per_node_per_period = c.network.sent / 16 / 20
+    assert per_node_per_period < 4.0
+
+
+def test_stock_demo_crash_detection_and_dissemination():
+    """The 32-node stock demo: kill a node, everyone learns within bounded
+    time (suspicion ≈ 5*log10(32) ≈ 8 periods + detection + gossip)."""
+    c = SimCluster(stock(32), seed=1)
+    c.start()
+    c.run(5.0)
+    c.kill(13)
+    dt = c.detection_time(13, timeout_s=15.0)
+    assert dt is not None and dt < 6.0, dt
+    c.run(25.0)
+    live = [i for i in range(32) if i != 13]
+    assert c.all_consider(13, Status.DEAD, among=live)
+    for m in live:
+        assert c.all_consider(m, Status.ALIVE, among=live)
+
+
+def test_detection_under_packet_loss():
+    """10% loss: the real death is still detected everywhere; the suspicion
+    mechanism keeps false positives rare (zero-FP is NOT a SWIM guarantee —
+    a suspicion whose refutation round-trip exceeds the timeout sticks, which
+    is exactly the λ trade-off BASELINE.md config 3 sweeps)."""
+    c = SimCluster(stock(24), seed=2, loss=0.10)  # default suspicion_mult=5
+    c.start()
+    c.run(5.0)
+    c.kill(3)
+    c.run(40.0)
+    live = [i for i in range(24) if i != 3]
+    assert c.all_consider(3, Status.DEAD, among=live)
+    false_deaths = sum(
+        1 for m in live for i in live
+        if c.nodes[i].members.opinion(m).status == Status.DEAD)
+    assert false_deaths <= 2, false_deaths
+
+
+def test_partition_and_heal_refutation():
+    """Brief partition → suspicions → heal → refutations win, nobody dies.
+
+    The partition must be short relative to the suspicion timeout
+    (6·log10(12) ≈ 6.5 s here): refutation needs the suspect gossip to reach
+    the suspect and the ALIVE@inc+1 to travel back before timers expire. A
+    partition comparable to the timeout genuinely kills nodes in vanilla
+    SWIM — that case is covered by test_partition_mutual_death in the
+    oracle suite, not here.
+    """
+    cfg = stock(12, suspicion_mult=6.0)
+    c = SimCluster(cfg, seed=3)
+    c.start()
+    c.run(4.0)
+    c.partition_halves()
+    c.run(1.5)  # 1–2 probe periods: suspicions arise with fresh budgets
+    c.heal()
+    c.run(30.0)
+    for m in range(12):
+        assert c.all_consider(m, Status.ALIVE), f"node {m} not alive-everywhere"
+    assert sum(n.stats["refutations"] for n in c.nodes) > 0
+
+
+def test_partition_and_heal_lifeguard_buddy():
+    """Same shape, longer partition, Lifeguard on: the buddy system keeps
+    telling the suspect it is suspected on every direct probe after heal,
+    making refutation robust where vanilla would be marginal."""
+    cfg = stock(12, suspicion_mult=6.0, lifeguard=True)
+    c = SimCluster(cfg, seed=31)
+    c.start()
+    c.run(4.0)
+    c.partition_halves()
+    c.run(3.0)
+    c.heal()
+    c.run(30.0)
+    for m in range(12):
+        assert c.all_consider(m, Status.ALIVE), f"node {m} not alive-everywhere"
+    assert sum(n.stats["refutations"] for n in c.nodes) > 0
+
+
+def test_join_via_seed():
+    """A new node joins through a seed and converges to full membership."""
+    cfg = stock(8)
+    c = SimCluster(cfg, seed=4)
+    c.start()
+    c.run(3.0)
+    joiner_t = InProcessTransport(c.network, 100)
+    joiner = Node(cfg, 100, joiner_t, c.clock, seed=100)
+    joiner.start(seeds=[("sim", 0)])
+    c.run(8.0)
+    # joiner learned everyone
+    assert len(joiner.members) == 9
+    # and everyone learned the joiner
+    for n in c.nodes:
+        op = n.members.opinion(100)
+        assert op is not None and op.status == Status.ALIVE
+
+
+def test_lifeguard_cluster_converges():
+    c = SimCluster(stock(16, lifeguard=True), seed=5, loss=0.05)
+    c.start()
+    c.run(10.0)
+    c.kill(7)
+    c.run(40.0)
+    live = [i for i in range(16) if i != 7]
+    assert c.all_consider(7, Status.DEAD, among=live)
+    for m in live:
+        assert c.all_consider(m, Status.ALIVE, among=live)
+
+
+def test_dead_node_stays_dead_sticky():
+    c = SimCluster(stock(10, suspicion_mult=1.0), seed=6)
+    c.start()
+    c.run(3.0)
+    c.kill(2)
+    c.run(30.0)
+    live = [i for i in range(10) if i != 2]
+    assert c.all_consider(2, Status.DEAD, among=live)
+    # revived node id cannot clear its death with the same incarnation:
+    # sticky-dead lattice (docs/PROTOCOL.md §2)
+    # (rejoin-with-new-id is the supported path)
+
+
+@pytest.mark.parametrize("n", [2, 3])
+def test_tiny_clusters(n):
+    c = SimCluster(stock(n, suspicion_mult=1.0), seed=7)
+    c.start()
+    c.run(10.0)
+    assert c.converged_all_alive()
+    c.kill(n - 1)
+    c.run(20.0)
+    live = list(range(n - 1))
+    assert c.all_consider(n - 1, Status.DEAD, among=live)

@@ -1,0 +1,189 @@
+"""Unit tests: wire codec, membership table, gossip queue, sim clock."""
+
+import random
+
+import pytest
+
+from swim_tpu.core import codec
+from swim_tpu.core.clock import SimClock
+from swim_tpu.core.codec import Message, WireUpdate
+from swim_tpu.core.gossip import PiggybackQueue
+from swim_tpu.core.membership import MembershipTable
+from swim_tpu.types import MsgKind, Opinion, Status
+
+
+def wu(member, status=Status.ALIVE, inc=0, addr=("h", 1)):
+    return WireUpdate(member, status, inc, addr)
+
+
+class TestCodec:
+    def roundtrip(self, msg):
+        out = codec.decode(codec.encode(msg))
+        assert out == msg
+        return out
+
+    def test_all_kinds_roundtrip(self):
+        gossip = (wu(1), wu(2, Status.SUSPECT, 5, ("10.0.0.2", 9000)),
+                  wu(3, Status.DEAD, 2**30 - 1))
+        self.roundtrip(Message(kind=MsgKind.PING, sender=7, probe_seq=123,
+                               on_behalf=9, gossip=gossip))
+        self.roundtrip(Message(kind=MsgKind.ACK, sender=7, probe_seq=123))
+        self.roundtrip(Message(kind=MsgKind.NACK, sender=7, probe_seq=1))
+        self.roundtrip(Message(kind=MsgKind.PING_REQ, sender=2, probe_seq=4,
+                               target=17, target_addr=("sim", 17)))
+        self.roundtrip(Message(kind=MsgKind.JOIN, sender=99))
+        self.roundtrip(Message(kind=MsgKind.JOIN_REPLY, sender=1,
+                               gossip=tuple(wu(i) for i in range(200))))
+
+    def test_malformed_rejected(self):
+        good = codec.encode(Message(kind=MsgKind.PING, sender=1))
+        for bad in (b"", b"\x00", bytes([0xFF]) + good[1:],  # bad magic
+                    bytes([codec.MAGIC, 99]) + good[2:],     # bad version
+                    good[:-1],                                # truncated
+                    good[:2] + bytes([200]) + good[3:]):      # bad kind
+            with pytest.raises(codec.DecodeError):
+                codec.decode(bad)
+
+    def test_fuzz_random_bytes_never_crash(self):
+        rng = random.Random(0)
+        for _ in range(500):
+            buf = bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(0, 64)))
+            try:
+                codec.decode(buf)
+            except codec.DecodeError:
+                pass  # the only acceptable failure mode
+
+
+class TestMembership:
+    def test_lattice_merge_and_listeners(self):
+        events = []
+        t = MembershipTable(0, ("sim", 0), random.Random(1))
+        t.listeners.append(lambda m, old, new: events.append((m, old, new)))
+        assert t.apply(1, ("sim", 1), Opinion(Status.ALIVE, 0))
+        assert not t.apply(1, ("sim", 1), Opinion(Status.ALIVE, 0))  # no news
+        assert t.apply(1, ("sim", 1), Opinion(Status.SUSPECT, 0))
+        assert not t.apply(1, ("sim", 1), Opinion(Status.ALIVE, 0))  # stale
+        assert t.apply(1, ("sim", 1), Opinion(Status.ALIVE, 1))     # refute
+        assert t.opinion(1) == Opinion(Status.ALIVE, 1)
+        assert len(events) == 3  # one per state-changing apply
+
+    def test_refute_exceeds_any_suspicion(self):
+        t = MembershipTable(0, ("sim", 0))
+        t.apply(0, ("sim", 0), Opinion(Status.SUSPECT, 7))
+        new = t.refute()
+        assert new == Opinion(Status.ALIVE, 8)
+        assert t.incarnation == 8
+
+    def test_round_robin_probes_everyone_before_repeat(self):
+        t = MembershipTable(0, ("sim", 0), random.Random(2))
+        for i in range(1, 9):
+            t.note_member(i, ("sim", i))
+        seen = [t.next_probe_target() for _ in range(8)]
+        assert sorted(seen) == list(range(1, 9))  # full sweep, no repeats
+        again = [t.next_probe_target() for _ in range(8)]
+        assert sorted(again) == list(range(1, 9))
+
+    def test_dead_members_skipped(self):
+        t = MembershipTable(0, ("sim", 0), random.Random(3))
+        for i in range(1, 4):
+            t.note_member(i, ("sim", i))
+        t.apply(2, ("sim", 2), Opinion(Status.DEAD, 0))
+        picks = {t.next_probe_target() for _ in range(10)}
+        assert 2 not in picks
+        assert picks == {1, 3}
+
+    def test_no_targets(self):
+        t = MembershipTable(0, ("sim", 0))
+        assert t.next_probe_target() is None
+        assert t.random_members(3, {0}) == []
+
+
+class TestGossip:
+    def test_fewest_transmits_first_and_limit(self):
+        q = PiggybackQueue(max_piggyback=2)
+        q.enqueue(wu(1))
+        q.enqueue(wu(2))
+        q.enqueue(wu(3))
+        first = {u.member for u in q.select(limit=2)}
+        assert len(first) == 2
+        second = q.select(limit=2)
+        assert {u.member for u in second} & first != {u.member
+                                                      for u in second}
+        # after enough selections every entry exhausts its budget
+        for _ in range(6):
+            q.select(limit=2)
+        q.gc(limit=2)
+        assert len(q) == 0
+
+    def test_new_info_resets_budget(self):
+        q = PiggybackQueue(max_piggyback=1)
+        q.enqueue(wu(1, Status.ALIVE, 0))
+        q.select(limit=1)
+        q.enqueue(wu(1, Status.SUSPECT, 0))  # newer info about same member
+        assert [u.status for u in q.select(limit=1)] == [Status.SUSPECT]
+
+    def test_selection_deterministic_order(self):
+        q = PiggybackQueue(max_piggyback=1)
+        q.enqueue(wu(2))
+        q.enqueue(wu(1))
+        assert [u.member for u in q.select(limit=5)] == [1]  # tie → lowest id
+
+
+class TestSimClock:
+    def test_ordering_and_cancel(self):
+        c = SimClock()
+        fired = []
+        c.call_later(2.0, lambda: fired.append("b"))
+        c.call_later(1.0, lambda: fired.append("a"))
+        h = c.call_later(3.0, lambda: fired.append("x"))
+        h.cancel()
+        c.call_later(3.0, lambda: fired.append("c"))
+        c.advance(5.0)
+        assert fired == ["a", "b", "c"]
+        assert c.now() == 5.0
+        assert c.pending() == 0
+
+    def test_timer_scheduling_timer(self):
+        c = SimClock()
+        fired = []
+
+        def chain():
+            fired.append(c.now())
+            if len(fired) < 3:
+                c.call_later(1.0, chain)
+
+        c.call_later(1.0, chain)
+        c.advance(10.0)
+        assert fired == [1.0, 2.0, 3.0]
+
+
+class TestJoinSnapshot:
+    def test_large_snapshot_chunks_across_datagrams(self):
+        """>255 members must not blow the codec's gossip cap (chunked)."""
+        from swim_tpu import SwimConfig
+        from swim_tpu.core.clock import SimClock
+        from swim_tpu.core.node import Node
+
+        sent = []
+
+        class CaptureTransport:
+            local_address = ("sim", 0)
+
+            def send(self, to, payload):
+                sent.append((to, payload))
+
+            def set_receiver(self, r):
+                pass
+
+        node = Node(SwimConfig(n_nodes=600), 0, CaptureTransport(),
+                    SimClock(), seed=0)
+        node._running = True
+        node.bootstrap([(i, ("sim", i)) for i in range(600)])
+        node._on_join(Message(kind=MsgKind.JOIN, sender=600), ("sim", 600))
+        replies = [codec.decode(p) for _, p in sent]
+        assert all(r.kind == MsgKind.JOIN_REPLY for r in replies)
+        assert len(replies) == 4  # 601 members in chunks of 200
+        total = sum(len(r.gossip) for r in replies)
+        assert total == 601
+        assert all(len(r.gossip) <= 255 for r in replies)

@@ -1,0 +1,77 @@
+"""Study runner metrics + checkpoint/resume determinism."""
+
+import numpy as np
+
+import jax
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import dense
+from swim_tpu.sim import faults, runner
+from swim_tpu.utils import checkpoint
+
+
+def test_detection_metrics_match_paper_shape():
+    """1k-node-style study in miniature (config 2): crash 5% at known steps,
+    check the collected latency distribution is sane and every crash is
+    detected and disseminated."""
+    n, periods = 64, 40
+    cfg = SwimConfig(n_nodes=n, suspicion_mult=2.0)
+    plan = faults.with_crashes(faults.none(n), [3, 11, 29], [2, 5, 9])
+    res = runner.run_study(cfg, dense.init_state(cfg), plan,
+                           jax.random.key(0), periods)
+    s = runner.detection_summary(res, plan, periods)
+    assert s["crashed"] == 3
+    assert s["suspect_detected"] == 3
+    assert s["dead_view_detected"] == 3
+    assert s["disseminated_detected"] == 3
+    # uniform random probing: mean first-suspicion latency ≈ e/(e-1) ≈ 1.58
+    # periods; tiny sample so just bound it loosely
+    assert 1.0 <= s["suspect_latency_mean"] <= 4.0
+    # dead view must come after suspicion by roughly the suspicion timeout
+    assert s["dead_view_latency_mean"] >= s["suspect_latency_mean"] + 1
+    assert s["false_dead_views_final"] == 0
+    # series shapes
+    assert res.series.suspect_views.shape == (periods,)
+    assert int(res.series.max_incarnation[-1]) == 0  # nobody refuted
+
+
+def test_checkpoint_resume_bitwise(tmp_path):
+    """Resume from a mid-run checkpoint ⇒ bitwise-identical final state."""
+    n = 32
+    cfg = SwimConfig(n_nodes=n, suspicion_mult=2.0)
+    plan = faults.with_crashes(faults.none(n), [7], [3])
+    key = jax.random.key(5)
+
+    full = dense.run(cfg, dense.init_state(cfg), plan, key, 20)
+
+    half = dense.run(cfg, dense.init_state(cfg), plan, key, 10)
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save(path, half, key, 10)
+    restored, rkey, step = checkpoint.restore(path, dense.init_state(cfg))
+    assert step == 10
+    resumed = dense.run(cfg, restored, plan, rkey, 10)
+
+    for a, b in zip(full, resumed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_checkpoint_manager_rotation(tmp_path):
+    cfg = SwimConfig(n_nodes=8)
+    st = dense.init_state(cfg)
+    key = jax.random.key(0)
+    mgr = checkpoint.CheckpointManager(str(tmp_path), every=5, keep=2)
+    saved = [s for s in range(1, 31) if mgr.maybe_save(st, key, s)]
+    assert saved == [5, 10, 15, 20, 25, 30]
+    assert mgr.latest().endswith("ckpt_000000000030.npz")
+    import os
+    assert len(os.listdir(tmp_path)) == 2  # retention
+
+
+def test_checkpoint_structure_mismatch_rejected(tmp_path):
+    cfg8 = SwimConfig(n_nodes=8)
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save(path, dense.init_state(cfg8), jax.random.key(0), 1)
+    cfg16 = SwimConfig(n_nodes=16)
+    import pytest
+    with pytest.raises(ValueError):
+        checkpoint.restore(path, dense.init_state(cfg16))

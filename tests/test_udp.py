@@ -1,0 +1,49 @@
+"""Real-network end-to-end: SWIM nodes over UDP sockets on localhost.
+
+Short protocol periods (50 ms) keep wall-clock small; this is the
+one-per-host deployment path (UDPTransport + AsyncioClock) exercised for
+join, convergence, and crash detection.
+"""
+
+import asyncio
+
+from swim_tpu import SwimConfig, Status
+from swim_tpu.core.clock import AsyncioClock
+from swim_tpu.core.node import Node
+from swim_tpu.core.transport import UDPTransport
+
+
+def test_udp_cluster_join_converge_detect():
+    async def scenario():
+        cfg = SwimConfig(n_nodes=5, protocol_period=0.05, suspicion_mult=2.0)
+        clock = AsyncioClock(asyncio.get_running_loop())
+        transports, nodes = [], []
+        for i in range(5):
+            t = await UDPTransport.create("127.0.0.1", 0)
+            transports.append(t)
+            nodes.append(Node(cfg, i, t, clock, seed=i))
+        seed_addr = transports[0].local_address
+        nodes[0].start()
+        for n in nodes[1:]:
+            n.start(seeds=[seed_addr])
+        await asyncio.sleep(1.5)  # ~30 periods: join + gossip convergence
+        for n in nodes:
+            assert len(n.members) == 5, (n.id, len(n.members))
+            for m in range(5):
+                op = n.members.opinion(m)
+                assert op is not None and op.status == Status.ALIVE, (n.id, m)
+
+        # crash-stop node 4 (close its socket, stop timers)
+        nodes[4].stop()
+        transports[4].close()
+        await asyncio.sleep(2.0)  # detect + suspicion (2*log10(5)→2 periods)
+        for n in nodes[:4]:
+            op = n.members.opinion(4)
+            assert op is not None and op.status == Status.DEAD, (n.id, op)
+
+        for n in nodes[:4]:
+            n.stop()
+        for t in transports[:4]:
+            t.close()
+
+    asyncio.run(scenario())

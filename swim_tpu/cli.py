@@ -84,15 +84,18 @@ def _cmd_demo(args: argparse.Namespace) -> int:
 
 
 def _cmd_simulate(args: argparse.Namespace) -> int:
+    import time
+
     import jax
     import numpy as np
 
     from swim_tpu import SwimConfig
-    from swim_tpu.models import dense
+    from swim_tpu.models import dense, rumor
     from swim_tpu.ops import lattice
     from swim_tpu.parallel import mesh as pmesh
-    from swim_tpu.sim import faults
+    from swim_tpu.sim import experiments, faults
 
+    engine = experiments.pick_engine(args.nodes, args.engine)
     cfg = SwimConfig(n_nodes=args.nodes, suspicion_mult=args.suspicion_mult,
                      lifeguard=args.lifeguard)
     plan = faults.none(args.nodes)
@@ -103,31 +106,63 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
             plan, jax.random.key(args.seed + 1), args.crash_fraction,
             0, max(1, args.periods // 2))
     mesh = pmesh.make_mesh()
-    state = pmesh.shard_state(dense.init_state(cfg), mesh)
-    plan = pmesh.shard_state(plan, mesh)
-    import time
+    mod = dense if engine == "dense" else rumor
+    state = pmesh.shard_state(mod.init_state(cfg), mesh, n=args.nodes)
+    plan = pmesh.shard_state(plan, mesh, n=args.nodes)
     t0 = time.perf_counter()
-    state = dense.run(cfg, state, plan, jax.random.key(args.seed),
-                      args.periods)
+    state = mod.run(cfg, state, plan, jax.random.key(args.seed),
+                    args.periods)
     jax.block_until_ready(state)
     dt = time.perf_counter() - t0
 
     crashed = np.asarray(plan.crash_step) <= args.periods
-    keys = np.asarray(state.key)
-    dead_views = np.asarray(lattice.is_dead(keys))
     live = ~crashed
-    detected = (dead_views[np.ix_(live, crashed)].all(axis=0).sum()
-                if crashed.any() else 0)
-    print(json.dumps({
+    if engine == "dense":
+        dead_views = np.asarray(lattice.is_dead(state.key))
+    else:
+        dead_views = np.asarray(lattice.is_dead(
+            rumor.view_matrix(cfg, state))) if args.nodes <= 8192 else None
+    out = {
         "nodes": args.nodes,
+        "engine": engine,
         "periods": args.periods,
         "seconds": round(dt, 3),
         "periods_per_sec": round(args.periods / dt, 2),
         "crashed": int(crashed.sum()),
-        "crashed_detected_by_all_live": int(detected),
-        "false_deaths": int(dead_views[np.ix_(live, live)].sum()),
         "devices": len(jax.devices()),
-    }))
+    }
+    if dead_views is not None:
+        detected = (dead_views[np.ix_(live, crashed)].all(axis=0).sum()
+                    if crashed.any() else 0)
+        out["crashed_detected_by_all_live"] = int(detected)
+        out["false_deaths"] = int(dead_views[np.ix_(live, live)].sum())
+    else:
+        gone = np.asarray(lattice.is_dead(state.gone_key))
+        out["tombstoned"] = int(gone.sum())
+        out["tombstoned_crashed"] = int((gone & crashed).sum())
+        out["overflow"] = int(state.overflow)
+    print(json.dumps(out))
+    return 0
+
+
+def _cmd_study(args: argparse.Namespace) -> int:
+    from swim_tpu.sim import experiments
+
+    kw = dict(n=args.nodes, periods=args.periods, seed=args.seed,
+              engine=args.engine)
+    if args.study == "detection":
+        kw["crash_fraction"] = args.crash_fraction
+    elif args.study == "fp_sweep":
+        kw["losses"] = tuple(args.losses)
+        kw["partition"] = not args.no_partition
+    elif args.study == "suspicion_sweep":
+        kw["mults"] = tuple(args.mults)
+        kw["crash_fraction"] = args.crash_fraction
+        kw["loss"] = args.loss
+    elif args.study == "lifeguard":
+        kw["crash_fraction"] = args.crash_fraction
+        kw["loss"] = args.loss
+    print(json.dumps(experiments.STUDIES[args.study](**kw)))
     return 0
 
 
@@ -167,7 +202,27 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--crash-fraction", type=float, default=0.01)
     sim.add_argument("--suspicion-mult", type=float, default=5.0)
     sim.add_argument("--lifeguard", action="store_true")
+    sim.add_argument("--engine", choices=("auto", "dense", "rumor"),
+                     default="auto")
     sim.set_defaults(fn=_cmd_simulate)
+
+    st = sub.add_parser(
+        "study", help="BASELINE.md studies (configs 2-5) → JSON")
+    st.add_argument("study", choices=("detection", "fp_sweep",
+                                      "suspicion_sweep", "lifeguard"))
+    st.add_argument("--nodes", type=int, default=1000)
+    st.add_argument("--periods", type=int, default=100)
+    st.add_argument("--seed", type=int, default=0)
+    st.add_argument("--engine", choices=("auto", "dense", "rumor"),
+                    default="auto")
+    st.add_argument("--crash-fraction", type=float, default=0.01)
+    st.add_argument("--loss", type=float, default=0.05)
+    st.add_argument("--losses", type=float, nargs="*",
+                    default=[0.0, 0.1, 0.2, 0.3])
+    st.add_argument("--mults", type=float, nargs="*",
+                    default=[2.0, 3.0, 5.0, 8.0])
+    st.add_argument("--no-partition", action="store_true")
+    st.set_defaults(fn=_cmd_study)
     return p
 
 

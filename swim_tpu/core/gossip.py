@@ -47,6 +47,13 @@ class PiggybackQueue:
             e.transmits += 1
         return [e.update for e in sel]
 
+    def refund(self, update: WireUpdate) -> None:
+        """Un-count one send of an update that was selected but then
+        displaced from the outgoing message (Lifeguard buddy force)."""
+        e = self._entries.get(update.member)
+        if e is not None and e.update == update and e.transmits > 0:
+            e.transmits -= 1
+
     def gc(self, limit: int) -> None:
         """Drop entries whose retransmit budget is exhausted."""
         self._entries = {m: e for m, e in self._entries.items()

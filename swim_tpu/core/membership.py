@@ -71,10 +71,16 @@ class MembershipTable:
 
     # -- mutation -----------------------------------------------------------
 
-    def note_member(self, member: int, addr: Address) -> None:
-        """Learn a member exists (e.g. from a join) without an opinion yet."""
+    def note_member(self, member: int, addr: Address) -> bool:
+        """Learn a member exists (e.g. from a join) without an opinion yet.
+
+        Returns True iff the member was new — callers gossip the discovery
+        so joins disseminate infection-style in O(log N) periods rather
+        than by O(N) direct contact."""
         if member not in self._members:
             self._apply_new(member, addr, Opinion(Status.ALIVE, 0))
+            return True
+        return False
 
     def apply(self, member: int, addr: Address, op: Opinion) -> bool:
         """Lattice-merge a received update. True iff it was new information.

@@ -15,11 +15,13 @@ Time and wire are injected (Clock + Transport), so the same Node runs:
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
+import math
 import random
 from typing import Callable
 
-from swim_tpu.config import SwimConfig
+from swim_tpu.config import SwimConfig, log_n_of
 from swim_tpu.core.clock import Clock, TimerHandle
 from swim_tpu.core.codec import (Address, DecodeError, Message, WireUpdate,
                                  decode, encode)
@@ -189,10 +191,6 @@ class Node:
         self.stats["suspicions"] += 1
 
     def _suspicion_timeout(self, confirmations: int) -> float:
-        import math
-
-        from swim_tpu.config import log_n_of
-
         n = max(self.members.alive_count(), 2)
         base = self.cfg.suspicion_mult * log_n_of(n) * self.cfg.protocol_period
         if not (self.cfg.lifeguard and self.cfg.dynamic_suspicion):
@@ -218,7 +216,9 @@ class Node:
         if not (self.cfg.lifeguard and self.cfg.dynamic_suspicion):
             return
         elapsed = self.clock.now() - s.started
-        remain = self._suspicion_timeout(len(s.confirmers)) - elapsed
+        # c = extra suspectors beyond the originator (docs/PROTOCOL.md §7:
+        # a lone suspector waits the full max; matches rumor.py's filled-1)
+        remain = self._suspicion_timeout(len(s.confirmers) - 1) - elapsed
         s.timer.cancel()
         s.timer = self.clock.call_later(
             max(remain, 0.0), lambda: self._on_suspicion_expired(member))
@@ -255,15 +255,23 @@ class Node:
         }[msg.kind]
         handler(msg, src)
 
+    def _note_and_gossip(self, member: int, addr: Address) -> None:
+        """Register a directly-observed member; gossip the discovery if new
+        so joins disseminate infection-style (O(log N) periods), not by
+        O(N) direct contact."""
+        if self.members.note_member(member, addr):
+            self.gossip.enqueue(WireUpdate(member, Status.ALIVE, 0, addr,
+                                           origin=self.id))
+
     def _on_ping(self, msg: Message, src: Address) -> None:
-        self.members.note_member(msg.sender, src)
+        self._note_and_gossip(msg.sender, src)
         self._send_to_addr(src, self._with_gossip(Message(
             kind=MsgKind.ACK, sender=self.id, probe_seq=msg.probe_seq,
             on_behalf=msg.on_behalf)))
 
     def _on_ping_req(self, msg: Message, src: Address) -> None:
         """Probe `msg.target` on the requester's behalf and relay the result."""
-        self.members.note_member(msg.sender, src)
+        self._note_and_gossip(msg.sender, src)
         sub_seq = next(self._seq)
         self._relays[sub_seq] = (src, msg.probe_seq, msg.target)
         self._send_to_addr(msg.target_addr, self._with_gossip(
@@ -302,7 +310,7 @@ class Node:
             probe.nacked = True
 
     def _on_join(self, msg: Message, src: Address) -> None:
-        self.members.note_member(msg.sender, src)
+        self._note_and_gossip(msg.sender, src)
         snapshot = [
             WireUpdate(m.id, m.opinion.status, m.opinion.incarnation, m.addr,
                        origin=self.id)
@@ -384,21 +392,18 @@ class Node:
                           origin=self.id)
 
     def _retransmit_limit(self) -> int:
-        import math
-
-        from swim_tpu.config import log_n_of
-
         n = max(self.members.alive_count(), 2)
         return max(1, math.ceil(self.cfg.retransmit_mult * log_n_of(n)))
 
     def _with_gossip(self, msg: Message,
                      forced: WireUpdate | None = None) -> Message:
-        import dataclasses
-
         sel = self.gossip.select(self._retransmit_limit())
         if forced is not None and all(u.member != forced.member
                                       for u in sel):
-            sel = [forced] + sel[:self.cfg.max_piggyback - 1]
+            kept = sel[:self.cfg.max_piggyback - 1]
+            for displaced in sel[self.cfg.max_piggyback - 1:]:
+                self.gossip.refund(displaced)  # charged but never sent
+            sel = [forced] + kept
         return dataclasses.replace(msg, gossip=tuple(sel))
 
     def _send(self, member: int, msg: Message,

@@ -1,0 +1,546 @@
+"""Scalable rumor engine — O(R·N) SWIM simulation for 100k–1M nodes.
+
+The dense engine (swim_tpu/models/dense.py) stores every pairwise opinion:
+9·N² bytes is ~9 TB at 1M nodes. This engine exploits what SWIM actually
+disseminates: a small working set of *rumors*. A rumor is one membership
+assertion `(subject, lattice key)` — SUSPECT(v)/ALIVE(v)/DEAD(v) about one
+node — and a node's view of subject j is exactly
+
+    view(i, j) = join( ALIVE(0), own-ALIVE if j == i,
+                       { rumor.key : rumor about j that i has heard } )
+
+because the opinion lattice join (swim_tpu/ops/lattice.py) is associative
+and commutative. So the full simulation state is a bounded rumor table
+(capacity R = cfg.rumor_slots) plus a heard-bitmask `knows[N, R]` — memory
+O(R·N + N) instead of O(N²), with the node axis sharded across the TPU mesh
+exactly like the dense engine.
+
+Documented deviations from the exact protocol (docs/PROTOCOL.md §6), chosen
+so that each is either statistically neutral or strictly pessimistic:
+
+1. **Piggyback ordering**: exact SWIM prefers least-retransmitted updates
+   per (sender, subject). Per-pair counters are O(N²), so eligibility is by
+   rumor *age* — a rumor is transmissible while `t - birth < gossip_window`
+   (the same Θ(retransmit_limit) budget the counters enforce: a node makes
+   Θ(1) sends per period) — and selection prefers the *youngest* eligible
+   rumors, which is what low-retransmit-count ordering converges to.
+2. **Suspicion expiry via sentinels**: exact SWIM lets every suspector
+   time out independently; all produce the identical DEAD(v) key, so only
+   the earliest matters for the projected view. The rumor tracks up to
+   `cfg.sentinels` earliest *independent suspectors* (the originator plus
+   later nodes whose own probe of the subject also failed); expiry fires
+   when any live, un-refuted sentinel passes its deadline. Non-sentinel
+   suspectors never confirm — visible only if every sentinel crashes
+   (≥ S simultaneous failures) and as ≤1 period of extra dissemination
+   skew (gossip hop instead of local expiry).
+3. **Believed-dead probe targets are resampled ≤ 4 times**, then the node
+   idles for the period (exact: one draw from the masked candidate CDF).
+   Proxies are not dead-checked at all (a dead proxy just fails).
+4. **Origination budget**: at most `origination_budget` new rumors per
+   period enter the table (confirm > refute > suspect priority); the rest
+   are dropped and counted in `state.overflow`. A dropped suspicion is
+   re-detected by the next failed probe, a dropped confirm by re-suspicion,
+   so overload degrades into detection latency, never into wrong state.
+
+In the exact regime — piggyback bound ≥ active rumors, gossip window ≥ run
+length, no confirmed deaths — the projected views are bitwise-identical to
+the dense engine under the same PeriodRandomness (tests/test_rumor_vs_dense
+.py); elsewhere agreement is statistical.
+
+Reference parity note: the reference (jpfuentes2/swim, Haskell — tree
+unavailable at survey time, SURVEY.md §0) has no simulator at all; this
+engine is the TPU-native capability the north star adds on top of the
+reference's per-node protocol semantics (docs/PROTOCOL.md §3–§7).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.ops import lattice
+from swim_tpu.sim.faults import FaultPlan
+from swim_tpu.utils.prng import PeriodRandomness, draw_period
+
+RESAMPLE_ATTEMPTS = 4
+_BIG = jnp.int32(2**30)
+
+
+class RumorState(NamedTuple):
+    """Sharded node-axis tensors first, then the replicated rumor table."""
+
+    # --- per node (leading axis N, sharded across the mesh) ---
+    knows: jax.Array      # bool[N, R]  node i has heard rumor r
+    inc_self: jax.Array   # u32[N]      own incarnation
+    lha: jax.Array        # i32[N]      Lifeguard local health score
+    gone_key: jax.Array   # u32[N]      tombstone floor, indexed by SUBJECT:
+    #                       a DEAD rumor retires here only once every live
+    #                       node has heard it, after which it floors every
+    #                       node's view of that subject (see `step` Phase 0)
+    # --- rumor table (leading axis R, replicated) ---
+    subject: jax.Array    # i32[R]      subject node id; -1 = free slot
+    rkey: jax.Array       # u32[R]      asserted lattice key
+    birth: jax.Array      # i32[R]      period originated
+    sent_node: jax.Array  # i32[R, S]   independent suspectors; -1 = empty
+    sent_time: jax.Array  # i32[R, S]   period each sentinel began suspecting
+    confirmed: jax.Array  # bool[R]     suspicion already produced its DEAD
+    # --- scalars ---
+    overflow: jax.Array   # i32         originations dropped (budget/table)
+    step: jax.Array       # i32         periods completed
+
+
+class RumorRandomness(NamedTuple):
+    base: PeriodRandomness
+    resample_u: jax.Array  # f32[N, RESAMPLE_ATTEMPTS] believed-dead redraws
+
+
+def draw_period_rumor(key: jax.Array, step, cfg: SwimConfig) -> RumorRandomness:
+    base = draw_period(key, step, cfg)
+    rk = jax.random.fold_in(jax.random.fold_in(key, step), 0x5e71)
+    return RumorRandomness(
+        base=base,
+        resample_u=jax.random.uniform(rk, (cfg.n_nodes, RESAMPLE_ATTEMPTS)),
+    )
+
+
+def _budget(cfg: SwimConfig) -> int:
+    """Max originations per period (candidate compaction width)."""
+    return min(cfg.rumor_slots, 256)
+
+
+def _pig_window(cfg: SwimConfig) -> int:
+    """Global candidate width W for piggyback selection (≥ B)."""
+    b = min(cfg.max_piggyback, cfg.rumor_slots)
+    return min(cfg.rumor_slots, max(8 * b, 64))
+
+
+def init_state(cfg: SwimConfig) -> RumorState:
+    n, r, s = cfg.n_nodes, cfg.rumor_slots, cfg.sentinels
+    return RumorState(
+        knows=jnp.zeros((n, r), jnp.bool_),
+        inc_self=jnp.zeros((n,), jnp.uint32),
+        lha=jnp.zeros((n,), jnp.int32),
+        gone_key=jnp.zeros((n,), jnp.uint32),
+        subject=jnp.full((r,), -1, jnp.int32),
+        rkey=jnp.zeros((r,), jnp.uint32),
+        birth=jnp.zeros((r,), jnp.int32),
+        sent_node=jnp.full((r, s), -1, jnp.int32),
+        sent_time=jnp.zeros((r, s), jnp.int32),
+        confirmed=jnp.zeros((r,), jnp.bool_),
+        overflow=jnp.int32(0),
+        step=jnp.int32(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Views (derived, never stored)
+# ---------------------------------------------------------------------------
+
+def _about(subject: jax.Array, used: jax.Array, subj: jax.Array) -> jax.Array:
+    """bool[..., R]: rumor r is about subj[...] (broadcast compare)."""
+    return used[None, :] & (subject[None, :] == subj[..., None])
+
+
+def opinion_of(state: RumorState, subj: jax.Array) -> tuple[jax.Array,
+                                                            jax.Array]:
+    """Per-node opinion of one subject each: (key u32[N], argmax rumor i32[N]).
+
+    view(i, subj[i]) over the heard-rumor join, floored at ALIVE(0). The
+    returned rumor index is the join's witness (used by the buddy force);
+    -1 when the floor wins.
+    """
+    used = state.subject >= 0
+    mk = _about(state.subject, used, subj) & state.knows      # [N, R]
+    vals = jnp.where(mk, state.rkey, jnp.uint32(0))
+    best = jnp.max(vals, axis=-1)
+    arg = jnp.argmax(vals, axis=-1).astype(jnp.int32)
+    floor = jnp.maximum(lattice.alive_key(jnp.uint32(0)),
+                        state.gone_key[subj])
+    return jnp.maximum(best, floor), jnp.where(best > floor, arg, -1)
+
+
+def _believes_dead(state: RumorState, subj: jax.Array) -> jax.Array:
+    used = state.subject >= 0
+    mk = _about(state.subject, used, subj) & state.knows
+    return (jnp.any(mk & lattice.is_dead(state.rkey)[None, :], axis=-1)
+            | lattice.is_dead(state.gone_key[subj]))
+
+
+def view_matrix(cfg: SwimConfig, state: RumorState) -> jax.Array:
+    """u32[N, N] projected pairwise views — tests/metrics only (small N)."""
+    n = cfg.n_nodes
+    used = state.subject >= 0
+    base = jnp.maximum(lattice.alive_key(jnp.uint32(0)),
+                       state.gone_key)[None, :]
+    base = jnp.broadcast_to(base, (n, n))
+    ids = jnp.arange(n)
+    base = base.at[ids, ids].max(lattice.alive_key(state.inc_self))
+    vals = jnp.where(state.knows & used[None, :], state.rkey[None, :],
+                     jnp.uint32(0))                            # [N, R]
+    col = jnp.where(used, state.subject, n)                    # n → dropped
+    return base.at[:, col].max(vals, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# One protocol period
+# ---------------------------------------------------------------------------
+
+def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
+         rnd: RumorRandomness) -> RumorState:
+    """One protocol period for all N nodes (pure; jit with cfg static)."""
+    n, k, r_cap = cfg.n_nodes, cfg.k_indirect, cfg.rumor_slots
+    s_cap = cfg.sentinels
+    t = state.step
+    base = rnd.base
+    ids = jnp.arange(n, dtype=jnp.int32)
+    rr = jnp.arange(r_cap, dtype=jnp.int32)
+    crashed = t >= plan.crash_step
+    up = ~crashed
+    part_on = (t >= plan.partition_start) & (t < plan.partition_end)
+
+    # ---- Phase 0: retire stale rumors (docstring deviation 1/4) -----------
+    # Non-DEAD rumors age out after the gossip window (suspicions hang on
+    # until their own timer resolves). DEAD rumors are different: forgetting
+    # a death would make the cluster re-detect it forever, so a DEAD rumor
+    # stays until EVERY live node has heard it, and only then retires into
+    # the persistent `gone_key` tombstone floor — which also means a death
+    # confirmed inside a partition never leaks across it.
+    used = state.subject >= 0
+    age = t - state.birth
+    transmissible_for = jnp.int32(cfg.gossip_window)
+    # a suspicion must outlive its own (possibly Lifeguard-extended) timer
+    pend_horizon = jnp.int32(
+        (cfg.suspicion_max_periods if cfg.lifeguard and cfg.dynamic_suspicion
+         else cfg.suspicion_periods) + 2)
+    is_susp_r = lattice.is_suspect(state.rkey)
+    is_dead_r = lattice.is_dead(state.rkey)
+    gone_at_subj = state.gone_key[jnp.maximum(state.subject, 0)]   # u32[R]
+    same_subj = (state.subject[:, None] == state.subject[None, :])
+    glob_refuted = (jnp.any(
+        same_subj & used[None, :]
+        & (state.rkey[None, :] > state.rkey[:, None]), axis=-1)
+        | (gone_at_subj > state.rkey))
+    pending = (is_susp_r & ~state.confirmed & ~glob_refuted
+               & (age < pend_horizon))
+    live_total = jnp.sum(up).astype(jnp.int32)
+    knowers = jnp.sum(state.knows & up[:, None], axis=0).astype(jnp.int32)
+    disseminated = knowers >= live_total
+    retire_dead = used & is_dead_r & disseminated
+    gone_key = state.gone_key.at[
+        jnp.where(retire_dead, state.subject, n)].max(state.rkey, mode="drop")
+    keep = used & jnp.where(is_dead_r, ~disseminated,
+                            (age < transmissible_for) | pending)
+    subject = jnp.where(keep, state.subject, -1)
+    used = subject >= 0
+    st = state._replace(subject=subject, gone_key=gone_key)
+
+    # ---- Phase A: probe-target selection (deviation 3) --------------------
+    def skip_self(idx):
+        return idx + (idx >= ids).astype(jnp.int32)
+
+    def draw_tgt(u):
+        idx = (u * jnp.float32(n - 1)).astype(jnp.int32)
+        return skip_self(jnp.minimum(idx, n - 2))
+
+    target = draw_tgt(base.target_u)
+    bad = _believes_dead(st, target)
+    for a in range(RESAMPLE_ATTEMPTS):
+        nxt = draw_tgt(rnd.resample_u[:, a])
+        target = jnp.where(bad, nxt, target)
+        bad = bad & _believes_dead(st, target)
+    prober = up & ~bad & (n >= 2)
+
+    # proxies: uniform over j ∉ {i, T(i)} — the dense masked-CDF mapping
+    lo = jnp.minimum(ids, target)
+    hi = jnp.maximum(ids, target)
+    idx2 = (base.proxy_u * jnp.float32(max(n - 2, 1))).astype(jnp.int32)
+    idx2 = jnp.minimum(idx2, max(n - 3, 0))
+    prox = idx2 + (idx2 >= lo[:, None]).astype(jnp.int32)
+    prox = prox + (prox >= hi[:, None]).astype(jnp.int32)   # i32[N, k]
+    has_proxy = n > 2
+
+    def delivered(src, dst, u):
+        cut = part_on & (plan.partition_id[src] != plan.partition_id[dst])
+        return (~crashed[src] & ~crashed[dst] & ~cut
+                & (u >= plan.loss.astype(jnp.float32)))
+
+    # ---- Phase B: global piggyback candidates (deviation 1) ---------------
+    b_pig = min(cfg.max_piggyback, r_cap)
+    w_pig = _pig_window(cfg)
+    eligible = used & (age >= 0) & (age < transmissible_for)
+    # youngest first, ties by slot: ages are bounded by the gossip window
+    score = jnp.where(eligible, age * jnp.int32(r_cap) + rr, _BIG)
+    _, cand_idx = jax.lax.top_k(-score, w_pig)
+    cand_idx = cand_idx.astype(jnp.int32)
+    cand_valid = eligible[cand_idx]                          # bool[W]
+
+    knows = st.knows
+
+    def wave(knows, src, dst, sent, u_loss, forced):
+        """One message wave: per-sender top-B selection + scatter-OR merge.
+
+        src/dst/sent/u_loss/forced are flat [M] message arrays; forced is a
+        rumor index (-1 = none) force-included by the Lifeguard buddy rule
+        (added alongside the B selected — exact SWIM displaces the last
+        slot; deviation noted in the module docstring).
+        """
+        kn = knows[:, cand_idx] & cand_valid[None, :]         # [N, W]
+        pos = jnp.cumsum(kn.astype(jnp.int32), axis=-1)
+        prio = jnp.where(kn & (pos <= b_pig),
+                         jnp.int32(w_pig) - jnp.arange(w_pig, dtype=jnp.int32),
+                         0)
+        vals, wpos = jax.lax.top_k(prio, b_pig)               # [N, B]
+        sel = jnp.take(cand_idx, wpos)                        # rumor ids
+        val = vals > 0
+        ok = sent & delivered(src, dst, u_loss)               # [M]
+        upd = val[src] & ok[:, None]                          # [M, B]
+        knows = knows.at[dst[:, None], sel[src]].max(upd)
+        fok = ok & (forced >= 0)
+        knows = knows.at[dst, jnp.maximum(forced, 0)].max(fok)
+        return knows, ok
+
+    def buddy(knows_now, src, dst):
+        """Rumor index of src's SUSPECT witness about dst, -1 if none."""
+        if not (cfg.lifeguard and cfg.buddy):
+            return jnp.full(src.shape, -1, jnp.int32)
+        mk = _about(st.subject, used, dst) & knows_now[src]
+        vals = jnp.where(mk, st.rkey, jnp.uint32(0))
+        best = jnp.max(vals, axis=-1)
+        arg = jnp.argmax(vals, axis=-1).astype(jnp.int32)
+        return jnp.where(lattice.is_suspect(best), arg, -1)
+
+    no_force = jnp.full((n,), -1, jnp.int32)
+    src3 = jnp.repeat(ids, k)
+    dst3 = prox.reshape(-1)
+    tgt4 = jnp.repeat(target, k)
+    no_force_k = jnp.full((n * k,), -1, jnp.int32)
+
+    # W1 PING i→T(i)
+    knows, w1_ok = wave(knows, ids, target, prober, base.loss_w1,
+                        buddy(knows, ids, target))
+    # W2 ACK T(i)→i
+    knows, w2_ok = wave(knows, target, ids, w1_ok, base.loss_w2, no_force)
+    acked = w2_ok
+    # W3 PING-REQ i→p
+    need = prober & ~acked & has_proxy
+    sent3 = jnp.repeat(need, k)
+    knows, w3_ok = wave(knows, src3, dst3, sent3, base.loss_w3.reshape(-1),
+                        no_force_k)
+    # W4 proxy PING p→T(i)
+    knows, w4_ok = wave(knows, dst3, tgt4, w3_ok, base.loss_w4.reshape(-1),
+                        buddy(knows, dst3, tgt4))
+    # W5 target ACK T(i)→p
+    knows, w5_ok = wave(knows, tgt4, dst3, w4_ok, base.loss_w5.reshape(-1),
+                        no_force_k)
+    # W6 relay ACK p→i
+    knows, w6_ok = wave(knows, dst3, src3, w5_ok, base.loss_w6.reshape(-1),
+                        no_force_k)
+    relayed = jnp.any(w6_ok.reshape(n, k), axis=-1)
+    st = st._replace(knows=knows)
+
+    # ---- Phase C: end-of-period verdicts (docs/PROTOCOL.md §3) ------------
+
+    # 1. probe verdicts
+    probe_ok = acked | relayed
+    failed = prober & ~probe_ok
+    lha = st.lha
+    s_probe = lha
+    if cfg.lifeguard:
+        lha = jnp.where(prober,
+                        jnp.clip(lha + jnp.where(failed, 1, -1), 0,
+                                 cfg.lha_max), lha)
+        thin = base.lha_u < (jnp.float32(1.0)
+                             / (1 + s_probe).astype(jnp.float32))
+        failed = failed & thin
+    viewed_tk, _ = opinion_of(st, target)
+    v_status = lattice.status_of(viewed_tk)
+    mk_suspect = failed & (v_status == 0)            # new suspicion
+    re_suspect = failed & (v_status == 1)            # independent suspector
+    susp_key = lattice.suspect_key(lattice.incarnation_of(viewed_tk))
+
+    # 2. refutation (own view of self is SUSPECT → bump incarnation)
+    self_mk = _about(st.subject, used, ids) & st.knows
+    self_vals = jnp.where(self_mk, st.rkey, jnp.uint32(0))
+    self_best = jnp.maximum(jnp.max(self_vals, axis=-1),
+                            lattice.alive_key(st.inc_self))
+    refute = up & lattice.is_suspect(self_best)
+    new_inc = jnp.where(refute, lattice.incarnation_of(self_best) + 1,
+                        st.inc_self.astype(jnp.uint32)).astype(jnp.uint32)
+    inc_self = jnp.where(refute, new_inc, st.inc_self)
+    if cfg.lifeguard:
+        lha = jnp.where(refute, jnp.clip(lha + 1, 0, cfg.lha_max), lha)
+
+    # 3. suspicion expiry via sentinels (deviation 2)
+    filled = jnp.sum(st.sent_node >= 0, axis=-1).astype(jnp.int32)  # [R]
+    if cfg.lifeguard and cfg.dynamic_suspicion:
+        base_to = jnp.float32(cfg.suspicion_periods)
+        max_to = jnp.float32(cfg.suspicion_max_periods)
+        c_tot = jnp.float32(cfg.k_indirect + 1)
+        frac = jnp.log(jnp.maximum(filled.astype(jnp.float32), 1.0)
+                       ) / jnp.log(c_tot + 1.0)
+        timeout = jnp.maximum(base_to,
+                              max_to - (max_to - base_to) * frac)
+        timeout = jnp.ceil(timeout).astype(jnp.int32)
+    else:
+        timeout = jnp.full((r_cap,), cfg.suspicion_periods, jnp.int32)
+    snode = st.sent_node
+    sact = (snode >= 0) & (plan.crash_step[jnp.maximum(snode, 0)] > t)
+    deadline_hit = sact & (t >= st.sent_time + timeout[:, None])    # [R, S]
+    higher = (same_subj & used[None, :]
+              & (st.rkey[None, :] > st.rkey[:, None]))              # [R, R]
+    refuted_s = []
+    for s_i in range(s_cap):
+        kn_s = st.knows[jnp.maximum(snode[:, s_i], 0)]              # [R, R']
+        refuted_s.append(jnp.any(higher & kn_s, axis=-1))
+    refuted = jnp.stack(refuted_s, axis=-1)                         # [R, S]
+    can_confirm = deadline_hit & ~refuted
+    dead_key_r = lattice.dead_key(lattice.incarnation_of(st.rkey))
+    confirm = (used & is_susp_r & ~st.confirmed
+               & (dead_key_r > gone_key[jnp.maximum(st.subject, 0)])
+               & jnp.any(can_confirm, axis=-1))
+    conf_s = jnp.argmax(can_confirm, axis=-1)
+    conf_node = jnp.take_along_axis(snode, conf_s[:, None], axis=-1)[:, 0]
+
+    # ---- Phase D: originations (deviation 4) ------------------------------
+    # candidate order encodes priority: confirms, then refutes, then suspects
+    cb = _budget(cfg)
+    c_subj = jnp.concatenate([st.subject, ids, target])
+    c_key = jnp.concatenate([dead_key_r,
+                             lattice.alive_key(new_inc),
+                             susp_key])
+    c_orig = jnp.concatenate([jnp.maximum(conf_node, 0), ids, ids])
+    c_valid = jnp.concatenate([confirm, refute, mk_suspect | re_suspect])
+    c_src = jnp.concatenate([rr, jnp.full((2 * n,), -1, jnp.int32)])
+    c_susp = jnp.concatenate([jnp.zeros((r_cap + n,), jnp.bool_),
+                              jnp.ones((n,), jnp.bool_)])
+    total = jnp.sum(c_valid).astype(jnp.int32)
+    m = c_valid.shape[0]
+    (ci,) = jnp.nonzero(c_valid, size=cb, fill_value=m)
+    got = ci < m
+    ci = jnp.minimum(ci, m - 1)
+    subj_c = jnp.where(got, c_subj[ci], -1)
+    key_c = jnp.where(got, c_key[ci], 0)
+    orig_c = jnp.where(got, c_orig[ci], 0)
+    src_c = jnp.where(got, c_src[ci], -1)
+    susp_c = got & c_susp[ci]
+    overflow = st.overflow + jnp.maximum(total - cb, 0)
+
+    # dedup within candidates (earlier wins)
+    eq = (subj_c[:, None] == subj_c[None, :]) & (key_c[:, None] ==
+                                                 key_c[None, :])
+    earlier = jnp.tril(jnp.ones((cb, cb), jnp.bool_), k=-1)
+    dup_mask = eq & earlier & got[None, :] & got[:, None]
+    dup_prev = jnp.any(dup_mask, axis=-1)
+    win_idx = jnp.argmax(dup_mask, axis=-1)          # first match
+
+    # dedup vs table
+    ex = (used[None, :] & (subj_c[:, None] == subject[None, :])
+          & (key_c[:, None] == st.rkey[None, :]))
+    ex_match = jnp.any(ex, axis=-1)
+    ex_slot = jnp.argmax(ex, axis=-1).astype(jnp.int32)
+
+    needs_slot = got & ~dup_prev & ~ex_match
+    (free_slots,) = jnp.nonzero(~used, size=cb, fill_value=r_cap)
+    n_free = jnp.sum(~used).astype(jnp.int32)
+    apos = jnp.cumsum(needs_slot.astype(jnp.int32)) - 1
+    alloc_ok = needs_slot & (apos < jnp.minimum(n_free, cb))
+    slot_new = jnp.where(alloc_ok,
+                         free_slots[jnp.clip(apos, 0, cb - 1)], -1)
+    overflow = overflow + jnp.sum(needs_slot & ~alloc_ok)
+
+    slot_f0 = jnp.where(ex_match, ex_slot, slot_new)
+    slot_f = jnp.where(dup_prev, slot_f0[win_idx], slot_f0).astype(jnp.int32)
+    placed = got & (slot_f >= 0)
+
+    # write allocated slots (out-of-range indices drop)
+    wslot = jnp.where(alloc_ok, slot_f, r_cap)
+    subject = subject.at[wslot].set(subj_c, mode="drop")
+    rkey = st.rkey.at[wslot].set(key_c, mode="drop")
+    birth = st.birth.at[wslot].set(t, mode="drop")
+    confirmed = st.confirmed.at[wslot].set(False, mode="drop")
+    snode = snode.at[wslot].set(-1, mode="drop")
+    stime = st.sent_time.at[wslot].set(0, mode="drop")
+    # clear heard-bits of reused slots, then originators hear their rumor
+    newly = jnp.zeros((r_cap,), jnp.bool_).at[wslot].set(True, mode="drop")
+    knows = jnp.where(newly[None, :], False, st.knows)
+    knows = knows.at[jnp.where(placed, orig_c, n),
+                     jnp.maximum(slot_f, 0)].max(placed, mode="drop")
+
+    # sentinel joins: every placed suspect-class candidate is an independent
+    # suspector; give it a sentinel slot if one is free and it is new there
+    joiner = placed & susp_c
+    tgt_r = jnp.where(joiner, slot_f, r_cap)
+    already = jnp.any(snode[jnp.clip(tgt_r, 0, r_cap - 1)]
+                      == orig_c[:, None], axis=-1) & joiner
+    joiner = joiner & ~already
+    tgt_r = jnp.where(joiner, slot_f, r_cap)
+    same_r = (tgt_r[:, None] == tgt_r[None, :])
+    grp_rank = jnp.sum(same_r & earlier & joiner[None, :],
+                       axis=-1).astype(jnp.int32)
+    fill_now = jnp.sum(snode[jnp.clip(tgt_r, 0, r_cap - 1)] >= 0,
+                       axis=-1).astype(jnp.int32)
+    spos = fill_now + grp_rank
+    j_ok = joiner & (spos < s_cap)
+    wr = jnp.where(j_ok, tgt_r, r_cap)
+    ws = jnp.clip(spos, 0, s_cap - 1)
+    snode = snode.at[wr, ws].set(orig_c, mode="drop")
+    stime = stime.at[wr, ws].set(t, mode="drop")
+
+    # mark confirmed suspicions whose DEAD rumor actually landed
+    conf_ok_slot = jnp.where(placed & (src_c >= 0), src_c, r_cap)
+    confirmed = confirmed.at[conf_ok_slot].set(True, mode="drop")
+
+    # Crashed nodes are frozen by construction: delivered() blocks receipt,
+    # and every origination path (prober/refute/sentinel) requires liveness.
+    # Their heard-bits for *reused* slots are still cleared above — a frozen
+    # row only stays meaningful for rumors that are still in the table.
+    inc_self = jnp.where(crashed, state.inc_self, inc_self)
+    lha = jnp.where(crashed, state.lha, lha)
+
+    return RumorState(
+        knows=knows, inc_self=inc_self, lha=lha, gone_key=gone_key,
+        subject=subject, rkey=rkey, birth=birth,
+        sent_node=snode, sent_time=stime, confirmed=confirmed,
+        overflow=overflow, step=t + 1,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
+        root_key: jax.Array, periods: int) -> RumorState:
+    """Run `periods` protocol periods under one fused lax.scan."""
+
+    def body(stt, _):
+        rnd = draw_period_rumor(root_key, stt.step, cfg)
+        return step(cfg, stt, plan, rnd), None
+
+    state, _ = jax.lax.scan(body, state, None, length=periods)
+    return state
+
+
+class RumorEngine:
+    """Convenience wrapper holding (cfg, plan, state) with a jitted step."""
+
+    def __init__(self, cfg: SwimConfig, plan: FaultPlan,
+                 root_key: jax.Array | None = None):
+        self.cfg = cfg
+        self.plan = plan
+        self.root_key = (root_key if root_key is not None
+                         else jax.random.key(0))
+        self.state = init_state(cfg)
+        self._step = jax.jit(functools.partial(step, cfg))
+
+    def run(self, periods: int) -> RumorState:
+        self.state = run(self.cfg, self.state, self.plan, self.root_key,
+                         periods)
+        return self.state
+
+    def step_once(self, rnd: RumorRandomness | None = None) -> RumorState:
+        if rnd is None:
+            rnd = draw_period_rumor(self.root_key, self.state.step, self.cfg)
+        self.state = self._step(self.state, self.plan, rnd)
+        return self.state

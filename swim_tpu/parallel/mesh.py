@@ -38,30 +38,40 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_state(state, mesh: Mesh):
+def _node_dim(state, n: int | None) -> int | None:
+    """The node-axis length: explicit `n`, else the largest leading dim.
+
+    Pass `n` explicitly for states whose replicated tables can be longer
+    than the node axis (e.g. a RumorState with rumor_slots > n_nodes).
+    """
+    if n is not None:
+        return n
+    return max((x.shape[0] for x in jax.tree.leaves(state)
+                if getattr(x, "ndim", 0) >= 1), default=None)
+
+
+def shard_state(state, mesh: Mesh, n: int | None = None):
     """Place a per-node-leading-axis state pytree onto the mesh.
 
-    Arrays whose leading dim equals the (global) node count shard on it;
-    scalars replicate. Works for DenseState, RumorState, and FaultPlan.
+    Arrays whose leading dim equals the node count shard on it; everything
+    else replicates. Works for DenseState, RumorState, and FaultPlan.
     """
-    n = max((x.shape[0] for x in jax.tree.leaves(state)
-             if getattr(x, "ndim", 0) >= 1), default=None)
+    nn = _node_dim(state, n)
 
     def place(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n:
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == nn:
             return jax.device_put(x, node_sharding(mesh, x.ndim))
         return jax.device_put(x, replicated(mesh))
 
     return jax.tree.map(place, state)
 
 
-def state_shardings(state, mesh: Mesh):
+def state_shardings(state, mesh: Mesh, n: int | None = None):
     """The NamedSharding pytree matching `shard_state` (for jit donation)."""
-    n = max((x.shape[0] for x in jax.tree.leaves(state)
-             if getattr(x, "ndim", 0) >= 1), default=None)
+    nn = _node_dim(state, n)
 
     def spec(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == n:
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == nn:
             return node_sharding(mesh, x.ndim)
         return replicated(mesh)
 

@@ -1,0 +1,165 @@
+"""The BASELINE.md studies (configs 2–5) as callable experiments.
+
+Each function returns a JSON-able dict and scales from laptop CPU sizes to
+the full TPU-mesh targets purely by its `n` argument:
+
+  * `detection_study`     — config 2: N-node sim, random crash-stop
+    injection → first-detection-time distribution (the SWIM paper's
+    e/(e−1)-periods curve).
+  * `fp_sweep`            — config 3: packet loss (+ optional 2-way
+    partition) sweep → false-positive rates.
+  * `suspicion_sweep`     — config 4: suspicion-multiplier λ sweep →
+    detection latency vs false positives trade-off.
+  * `lifeguard_ablation`  — config 5: Lifeguard on/off under loss+crash.
+
+Engine selection: the exact dense engine up to `DENSE_MAX` nodes, the
+O(R·N) rumor engine above (BASELINE's 100k/1M configs). All on-device work
+runs under one jitted lax.scan per (config, periods); only O(periods)
+scalars and O(N) milestone vectors reach the host.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.models import dense, rumor
+from swim_tpu.parallel import mesh as pmesh
+from swim_tpu.sim import faults, runner
+
+DENSE_MAX = 8192
+
+
+def pick_engine(n: int, engine: str = "auto") -> str:
+    if engine != "auto":
+        return engine
+    return "dense" if n <= DENSE_MAX else "rumor"
+
+
+def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
+               periods: int, engine: str):
+    mesh = pmesh.make_mesh()
+    n = cfg.n_nodes
+    plan = pmesh.shard_state(plan, mesh, n=n)
+    if engine == "dense":
+        state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
+        return runner.run_study(cfg, state, plan, key, periods)
+    state = pmesh.shard_state(rumor.init_state(cfg), mesh, n=n)
+    return runner.run_study_rumor(cfg, state, plan, key, periods)
+
+
+def detection_study(n: int = 1000, crash_fraction: float = 0.01,
+                    periods: int = 100, seed: int = 0,
+                    engine: str = "auto", **cfg_kw) -> dict[str, Any]:
+    """Config 2: crash-stop injection → detection-time distribution."""
+    engine = pick_engine(n, engine)
+    cfg = SwimConfig(n_nodes=n, **cfg_kw)
+    plan = faults.with_random_crashes(
+        faults.none(n), jax.random.key(seed + 1), crash_fraction,
+        2, max(3, periods // 2))
+    res = _run_study(cfg, plan, jax.random.key(seed), periods, engine)
+    out = {"study": "detection", "n": n, "periods": periods,
+           "engine": engine, "crash_fraction": crash_fraction,
+           "suspicion_periods": cfg.suspicion_periods}
+    out.update(runner.detection_summary(res, plan, periods))
+    if engine == "rumor":
+        out["overflow"] = int(res.state.overflow)
+    return out
+
+
+def fp_sweep(n: int = 100_000, losses: tuple = (0.0, 0.1, 0.2, 0.3),
+             partition: bool = True, periods: int = 100, seed: int = 0,
+             engine: str = "auto", **cfg_kw) -> dict[str, Any]:
+    """Config 3: loss (+ optional mid-run 2-way partition) → FP rates.
+
+    A false positive is a live node holding a DEAD view of a live node at
+    the end of the run. With the partition enabled, each half is *expected*
+    to declare the other dead mid-run (that is SWIM working as specified);
+    the interesting number is `false_dead_views_final` measured after the
+    heal — whether refutation cleans the cluster up again is the paper's
+    suspicion-mechanism claim. (It cannot: DEAD is sticky — the reference
+    protocol needs re-join, which the sweep demonstrates quantitatively.)
+    """
+    engine = pick_engine(n, engine)
+    points = []
+    for loss in losses:
+        cfg = SwimConfig(n_nodes=n, **cfg_kw)
+        plan = faults.with_loss(faults.none(n), loss)
+        if partition:
+            plan = faults.with_partition(plan, faults.halves(n),
+                                         periods // 3, 2 * periods // 3)
+        res = _run_study(cfg, plan, jax.random.key(seed), periods, engine)
+        series = res.series
+        pt = {
+            "loss": loss,
+            "suspect_views_peak": int(np.asarray(
+                series.suspect_views).max()),
+            "false_dead_views_final": int(np.asarray(
+                series.false_dead_views)[-1]),
+            "false_dead_views_peak": int(np.asarray(
+                series.false_dead_views).max()),
+            "max_incarnation": int(np.asarray(
+                series.max_incarnation).max()),
+        }
+        if engine == "rumor":
+            pt["overflow"] = int(res.state.overflow)
+        points.append(pt)
+    return {"study": "fp_sweep", "n": n, "periods": periods,
+            "engine": engine, "partition": partition, "points": points}
+
+
+def suspicion_sweep(n: int = 1_000_000,
+                    mults: tuple = (2.0, 3.0, 5.0, 8.0),
+                    crash_fraction: float = 0.001, loss: float = 0.05,
+                    periods: int = 100, seed: int = 0,
+                    engine: str = "auto", **cfg_kw) -> dict[str, Any]:
+    """Config 4: suspicion-timeout λ sweep — latency vs FP trade-off."""
+    engine = pick_engine(n, engine)
+    points = []
+    for mult in mults:
+        cfg = SwimConfig(n_nodes=n, suspicion_mult=mult, **cfg_kw)
+        plan = faults.with_loss(
+            faults.with_random_crashes(
+                faults.none(n), jax.random.key(seed + 1), crash_fraction,
+                2, max(3, periods // 2)),
+            loss)
+        res = _run_study(cfg, plan, jax.random.key(seed), periods, engine)
+        pt = {"suspicion_mult": mult,
+              "suspicion_periods": cfg.suspicion_periods}
+        pt.update(runner.detection_summary(res, plan, periods))
+        points.append(pt)
+    return {"study": "suspicion_sweep", "n": n, "periods": periods,
+            "engine": engine, "loss": loss, "points": points}
+
+
+def lifeguard_ablation(n: int = 1_000_000, crash_fraction: float = 0.001,
+                       loss: float = 0.2, periods: int = 100, seed: int = 0,
+                       engine: str = "auto", **cfg_kw) -> dict[str, Any]:
+    """Config 5: Lifeguard extensions vs vanilla SWIM under lossy churn."""
+    engine = pick_engine(n, engine)
+    arms = {}
+    for name, lg in (("vanilla", False), ("lifeguard", True)):
+        cfg = SwimConfig(n_nodes=n, lifeguard=lg, **cfg_kw)
+        plan = faults.with_loss(
+            faults.with_random_crashes(
+                faults.none(n), jax.random.key(seed + 1), crash_fraction,
+                2, max(3, periods // 2)),
+            loss)
+        res = _run_study(cfg, plan, jax.random.key(seed), periods, engine)
+        arm = runner.detection_summary(res, plan, periods)
+        arm["false_dead_views_peak"] = int(np.asarray(
+            res.series.false_dead_views).max())
+        arms[name] = arm
+    return {"study": "lifeguard_ablation", "n": n, "periods": periods,
+            "engine": engine, "loss": loss, "arms": arms}
+
+
+STUDIES: dict[str, Callable[..., dict]] = {
+    "detection": detection_study,
+    "fp_sweep": fp_sweep,
+    "suspicion_sweep": suspicion_sweep,
+    "lifeguard": lifeguard_ablation,
+}

@@ -12,8 +12,10 @@ quantities BASELINE.md's studies need (configs 2–5):
     as incarnation bumps, false-death views) — psum-style full reductions
     that stay on device; only O(periods) scalars ever reach the host.
 
-Works on the dense engine state; the rumor engine provides its own cheaper
-collectors (its state already *is* event-shaped).
+`run_study` works on the dense engine state; `run_study_rumor` collects the
+same milestones from the rumor engine's event-shaped state in O(R·N) — a
+rumor's live-knower count is one masked reduction, and per-subject
+milestones are one scatter over the (tiny) rumor table.
 """
 
 from __future__ import annotations
@@ -110,6 +112,94 @@ def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
     (state, track), series = jax.lax.scan(body, (state, track0), None,
                                           length=periods)
     return StudyResult(state, track, PeriodSeries(*series))
+
+
+class RumorStudyResult(NamedTuple):
+    state: "rumor.RumorState"
+    track: StudyTrack
+    series: PeriodSeries
+
+
+def _rumor_subject_flags(cfg: SwimConfig, st, up: jax.Array):
+    """Per-subject (not-alive-seen, dead-seen, dead-disseminated) bool[N].
+
+    A subject's milestone fires when a matching rumor is known by ≥1 live
+    node (all live nodes, for dissemination) or has retired into the
+    `gone_key` tombstone (which by construction implies full dissemination).
+    View-based and rumor-based milestones coincide for crashed subjects,
+    who can never refute (the one divergence: a stale pre-crash refutation
+    outranking a stale suspicion — absent by construction here, since
+    tracked subjects stop acting at their crash step).
+    """
+    n = cfg.n_nodes
+    used = st.subject >= 0
+    live_total = jnp.sum(up).astype(jnp.int32)
+    knowers = jnp.sum(st.knows & up[:, None], axis=0).astype(jnp.int32)
+    is_s = lattice.is_suspect(st.rkey)
+    is_d = lattice.is_dead(st.rkey)
+    known = used & (knowers > 0)
+    sub = jnp.where(used, st.subject, n)
+    zeros = jnp.zeros((n,), jnp.bool_)
+    gone_dead = lattice.is_dead(st.gone_key)
+    not_alive = (zeros.at[sub].max(known & (is_s | is_d), mode="drop")
+                 | gone_dead)
+    dead_seen = zeros.at[sub].max(known & is_d, mode="drop") | gone_dead
+    dead_all = (zeros.at[sub].max(used & is_d & (knowers >= live_total),
+                                  mode="drop") | gone_dead)
+    counts = (
+        jnp.sum(jnp.where(used & is_s, knowers, 0)).astype(jnp.int32),
+        jnp.sum(jnp.where(used & is_d, knowers, 0)).astype(jnp.int32)
+        + jnp.sum(gone_dead) * live_total,
+    )
+    return not_alive, dead_seen, dead_all, counts
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
+                    root_key: jax.Array, periods: int) -> RumorStudyResult:
+    from swim_tpu.models import rumor as rumor_mod
+
+    n = cfg.n_nodes
+    track0 = StudyTrack(*(jnp.full((n,), NEVER, jnp.int32)
+                          for _ in range(3)))
+
+    def body(carry, _):
+        st, track = carry
+        rnd = rumor_mod.draw_period_rumor(root_key, st.step, cfg)
+        st = rumor_mod.step(cfg, st, plan, rnd)
+        t = st.step - 1
+        crashed = t >= plan.crash_step
+        up = ~crashed
+        not_alive, dead_seen, dead_all, counts = _rumor_subject_flags(
+            cfg, st, up)
+
+        def first(cur, cond):
+            hit = cond & crashed & (cur == NEVER)
+            return jnp.where(hit, t, cur)
+
+        track = StudyTrack(
+            first_suspect=first(track.first_suspect, not_alive),
+            first_dead_view=first(track.first_dead_view, dead_seen),
+            disseminated=first(track.disseminated, dead_all),
+        )
+        # dead views whose subject is actually alive (live viewers only)
+        used_r = st.subject >= 0
+        live_subj = up[jnp.maximum(st.subject, 0)]
+        live_total = jnp.sum(up).astype(jnp.int32)
+        knowers = jnp.sum(st.knows & up[:, None], axis=0).astype(jnp.int32)
+        false_dead = (jnp.sum(jnp.where(
+            used_r & lattice.is_dead(st.rkey) & live_subj, knowers, 0))
+            + jnp.sum(lattice.is_dead(st.gone_key) & up) * live_total
+        ).astype(jnp.int32)
+        series = (counts[0], counts[1], false_dead,
+                  jnp.maximum(
+                      jnp.max(lattice.incarnation_of(st.rkey)),
+                      jnp.max(st.inc_self)).astype(jnp.int32))
+        return (st, track), series
+
+    (state, track), series = jax.lax.scan(body, (state, track0), None,
+                                          length=periods)
+    return RumorStudyResult(state, track, PeriodSeries(*series))
 
 
 def detection_summary(result: StudyResult, plan: FaultPlan,

@@ -119,6 +119,23 @@ def test_join_via_seed():
         assert op is not None and op.status == Status.ALIVE
 
 
+def test_join_disseminates_by_gossip_not_direct_contact():
+    """In a 24-node cluster the join must reach everyone in O(log N)
+    protocol periods via piggybacked gossip — not the O(N) periods that
+    direct round-robin contact alone would need (regression: discoveries
+    were registered but never enqueued for gossip)."""
+    cfg = stock(24)
+    c = SimCluster(cfg, seed=6)
+    c.start()
+    c.run(3.0)
+    joiner_t = InProcessTransport(c.network, 100)
+    joiner = Node(cfg, 100, joiner_t, c.clock, seed=100)
+    joiner.start(seeds=[("sim", 0)])
+    c.run(8.0)  # 8 periods ≪ 24: only gossip can make this deadline
+    knowers = sum(1 for n in c.nodes if n.members.opinion(100) is not None)
+    assert knowers == len(c.nodes), f"only {knowers}/24 learned the joiner"
+
+
 def test_lifeguard_cluster_converges():
     c = SimCluster(stock(16, lifeguard=True), seed=5, loss=0.05)
     c.start()

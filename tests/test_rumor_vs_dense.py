@@ -1,0 +1,232 @@
+"""Rumor engine validation (docs/PROTOCOL.md §6).
+
+Layer 1 — exact regime: with the piggyback bound ≥ active rumors, gossip
+window ≥ run length, and no confirmed deaths, the rumor engine's projected
+pairwise views must be **bitwise identical** to the dense engine under the
+same PeriodRandomness, period by period.
+
+Layer 2 — statistical regime: with deaths (where deviations 2–3 apply),
+the engines must agree on every milestone to within the documented ≤1-period
+dissemination skew plus sampling noise.
+
+Layer 3 — invariants: tombstone persistence, overflow accounting, clean
+networks stay rumor-free, refutation suppresses false positives.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import dense, rumor
+from swim_tpu.ops import lattice
+from swim_tpu.sim import faults, runner
+
+
+def exact_cfg(n: int, **kw) -> SwimConfig:
+    """Config in the exact regime: full piggyback, effectively infinite
+    gossip window, long suspicion timeout (nothing expires in-test)."""
+    # the table and piggyback bound must exceed the ACTIVE RUMOR count —
+    # with an effectively infinite gossip window nothing ever retires, and
+    # lossy runs generate O(10) rumors per period (several generations per
+    # subject can coexist; dense sends per-subject joined keys, the rumor
+    # engine sends individual rumors)
+    kw.setdefault("rumor_capacity", 16 * n)
+    kw.setdefault("max_piggyback", 16 * n)
+    kw.setdefault("retransmit_mult", 1000.0)
+    kw.setdefault("suspicion_mult", 8.0)
+    return SwimConfig(n_nodes=n, **kw)
+
+
+def run_both(cfg, plan, periods, key=None):
+    """Step both engines on shared randomness; return per-period views."""
+    key = key if key is not None else jax.random.key(7)
+    ds, rs = dense.init_state(cfg), rumor.init_state(cfg)
+    dstep = jax.jit(lambda s, r: dense.step(cfg, s, plan, r))
+    rstep = jax.jit(lambda s, r: rumor.step(cfg, s, plan, r))
+    out = []
+    for t in range(periods):
+        rnd = rumor.draw_period_rumor(key, t, cfg)
+        ds = dstep(ds, rnd.base)
+        rs = rstep(rs, rnd)
+        out.append((np.asarray(ds.key),
+                    np.asarray(rumor.view_matrix(cfg, rs))))
+    return ds, rs, out
+
+
+class TestExactRegime:
+    def test_lossy_network_views_bitwise_equal(self):
+        """25% loss ⇒ suspicions + refutations, no deaths: exact match."""
+        cfg = exact_cfg(48)
+        plan = faults.with_loss(faults.none(48), 0.25)
+        _, rs, views = run_both(cfg, plan, 24)
+        for t, (dm, rm) in enumerate(views):
+            np.testing.assert_array_equal(dm, rm, err_msg=f"period {t}")
+        # the regime actually exercised refutation
+        assert int(np.asarray(rs.inc_self).max()) > 0
+        assert int(rs.overflow) == 0
+
+    def test_partition_views_bitwise_equal(self):
+        cfg = exact_cfg(32)
+        plan = faults.with_loss(faults.none(32), 0.1)
+        plan = faults.with_partition(plan, faults.halves(32), 3, 9)
+        _, _, views = run_both(cfg, plan, 14)
+        for t, (dm, rm) in enumerate(views):
+            np.testing.assert_array_equal(dm, rm, err_msg=f"period {t}")
+
+    def test_pre_confirmation_crash_views_bitwise_equal(self):
+        """Crash at t=2: views agree until the first suspicion expiry."""
+        cfg = exact_cfg(40)   # suspicion_periods = ceil(8*log10(40)) = 13
+        plan = faults.with_crashes(faults.none(40), [3], [2])
+        horizon = 2 + 1 + cfg.suspicion_periods - 1  # strictly pre-expiry
+        _, _, views = run_both(cfg, plan, horizon)
+        for t, (dm, rm) in enumerate(views):
+            np.testing.assert_array_equal(dm, rm, err_msg=f"period {t}")
+
+
+class TestStatisticalRegime:
+    def test_crash_detection_milestones_close_to_dense(self):
+        n, periods = 96, 60
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=256)
+        plan = faults.with_crashes(faults.none(n), [5, 41, 77], [3])
+        key = jax.random.key(11)
+        dres = runner.run_study(cfg, dense.init_state(cfg), plan, key,
+                                periods)
+        rres = runner.run_study_rumor(cfg, rumor.init_state(cfg), plan, key,
+                                      periods)
+        dsum = runner.detection_summary(dres, plan, periods)
+        rsum = runner.detection_summary(rres, plan, periods)
+        assert rsum["suspect_detected"] == 3
+        assert rsum["dead_view_detected"] == 3
+        assert rsum["disseminated_detected"] == 3
+        # same protocol constants ⇒ same timescales (suspicion timeout
+        # dominates); allow sampling noise + the ≤1-period dissemination skew
+        for k in ("suspect_latency_mean", "dead_view_latency_mean",
+                  "disseminated_latency_mean"):
+            assert abs(rsum[k] - dsum[k]) <= 3.0, (k, rsum[k], dsum[k])
+        assert rsum["false_dead_views_final"] == 0
+
+    def test_detection_time_matches_swim_paper_scaling(self):
+        """First suspicion of a crashed node lands within a few periods
+        (paper: ≈ e/(e−1) ≈ 1.58 expected at zero loss)."""
+        n, periods = 128, 50
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_crashes(faults.none(n), [17], [4])
+        lat = []
+        for seed in range(5):
+            res = runner.run_study_rumor(cfg, rumor.init_state(cfg), plan,
+                                         jax.random.key(seed), periods)
+            first = int(np.asarray(res.track.first_suspect)[17])
+            assert first != int(runner.NEVER)
+            lat.append(first - 4 + 1)
+        assert 1.0 <= float(np.mean(lat)) <= 4.0
+
+
+class TestInvariants:
+    def test_clean_network_stays_rumor_free(self):
+        cfg = SwimConfig(n_nodes=64)
+        eng = rumor.RumorEngine(cfg, faults.none(64))
+        st = eng.run(30)
+        assert int((np.asarray(st.subject) >= 0).sum()) == 0
+        assert int(st.overflow) == 0
+        assert int(np.asarray(st.inc_self).max()) == 0
+
+    def test_refutation_suppresses_false_positives_under_loss(self):
+        """At 10% loss refutation keeps FP views near zero (SWIM paper's
+        suspicion-mechanism claim — it only holds at low loss; both engines
+        mass-expire under sustained ≥20% loss with the stock B=6 piggyback,
+        which matches the paper's analysis of dissemination bandwidth)."""
+        cfg = SwimConfig(n_nodes=64, suspicion_mult=6.0)
+        plan = faults.with_loss(faults.none(64), 0.1)
+        res = runner.run_study_rumor(cfg, rumor.init_state(cfg), plan,
+                                     jax.random.key(3), 40)
+        fp = int(np.asarray(res.series.false_dead_views)[-1])
+        # dense on the identical run ends at 64 FP views of 64·63 ≈ 4k pairs
+        assert fp <= 64, fp
+        # loss actually caused suspicion traffic
+        assert int(np.asarray(res.series.suspect_views).max()) > 0
+
+    def test_death_survives_rumor_retirement(self):
+        """The tombstone (gone_key) keeps the death visible after the DEAD
+        rumor leaves the table, and the table drains to empty."""
+        n = 32
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=64)
+        plan = faults.with_crashes(faults.none(n), [5], [2])
+        eng = rumor.RumorEngine(cfg, plan)
+        st = eng.run(40)
+        assert int((np.asarray(st.subject) >= 0).sum()) == 0  # drained
+        assert lattice.is_dead(st.gone_key)[5]
+        vm = np.asarray(rumor.view_matrix(cfg, st))
+        live = ~np.asarray(faults.crashed_mask(plan, st.step))
+        assert bool(np.asarray(lattice.is_dead(vm))[live, 5].all())
+
+    def test_overflow_counted_not_crashed(self):
+        """A 2-slot table under mass failure overflows gracefully."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=2)
+        plan = faults.with_random_crashes(faults.none(n), jax.random.key(9),
+                                          0.5, 2, 3)
+        eng = rumor.RumorEngine(cfg, plan)
+        st = eng.run(20)
+        assert int(st.overflow) > 0
+
+    def test_same_period_duplicate_suspicions_share_one_rumor(self):
+        """k probers all failing on one crashed node the same period must
+        dedup to a single rumor with them as independent sentinels."""
+        n = 16
+        cfg = exact_cfg(n)
+        plan = faults.with_crashes(faults.none(n), [7], [0])
+        eng = rumor.RumorEngine(cfg, plan, jax.random.key(5))
+        for _ in range(3):
+            eng.step_once()
+        st = eng.state
+        sub = np.asarray(st.subject)
+        used = sub >= 0
+        about_7 = used & (sub == 7)
+        suspects = about_7 & np.asarray(lattice.is_suspect(st.rkey))
+        assert suspects.sum() == 1  # one rumor, not one per prober
+        sent = np.asarray(st.sent_node)[suspects][0]
+        assert (sent >= 0).sum() >= 1
+        assert len({s for s in sent if s >= 0}) == (sent >= 0).sum()
+
+    def test_lifeguard_dynamic_suspicion_shrinks_timeout(self):
+        """With confirmations the Lifeguard timeout approaches the vanilla
+        floor; a lone suspector waits suspicion_max_periods."""
+        n = 64
+        base = SwimConfig(n_nodes=n, lifeguard=True, dynamic_suspicion=True,
+                          suspicion_max_mult=3.0)
+        plan = faults.with_crashes(faults.none(n), [9], [2])
+        res = runner.run_study_rumor(base, rumor.init_state(base), plan,
+                                     jax.random.key(2), 80)
+        first_dead = int(np.asarray(res.track.first_dead_view)[9])
+        assert first_dead != int(runner.NEVER)
+        lat = first_dead - 2
+        # confirmations from k-indirect + repeat probes should land the
+        # timeout well below the 3× ceiling
+        assert lat < 2 + base.suspicion_max_periods
+        assert lat >= base.suspicion_periods - 1
+
+
+class TestShardedExecution:
+    def test_step_on_virtual_mesh(self):
+        from swim_tpu.parallel import mesh as pmesh
+
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=128)
+        mesh = pmesh.make_mesh(8)
+        plan = pmesh.shard_state(
+            faults.with_crashes(faults.none(n), [3], [0]), mesh, n=n)
+        st = pmesh.shard_state(rumor.init_state(cfg), mesh, n=n)
+        import functools
+
+        step = jax.jit(functools.partial(rumor.step, cfg),
+                       out_shardings=pmesh.state_shardings(st, mesh, n=n))
+        rnd = rumor.draw_period_rumor(jax.random.key(0), 0, cfg)
+        out = step(st, plan, rnd)
+        assert int(out.step) == 1
+        # single-device reference: same result
+        ref = rumor.step(cfg, rumor.init_state(cfg), plan, rnd)
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

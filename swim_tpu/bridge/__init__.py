@@ -1,0 +1,14 @@
+"""Host bridge: external protocol cores ↔ swim_tpu simulated clusters.
+
+The reference's `Swim.Transport` typeclass is the seam an external
+(Haskell) core plugs through; this package is the swim_tpu side of that
+seam — a lockstep TCP protocol (protocol.py), a cluster-hosting server
+(server.py), and the Python mock driver that defines the contract until
+the Haskell co-process exists (client.py). SURVEY.md §2 "Host bridge",
+§7 step 6.
+"""
+
+from swim_tpu.bridge.client import BridgeTransport, ExternalNodeHost
+from swim_tpu.bridge.server import BridgeServer
+
+__all__ = ["BridgeServer", "BridgeTransport", "ExternalNodeHost"]

@@ -1,0 +1,124 @@
+"""Bridge server: host a simulated cluster that external cores can join.
+
+`BridgeServer` owns a SimClock + SimNetwork (optionally pre-populated with
+in-process swim_tpu Nodes) and speaks the lockstep protocol of
+swim_tpu/bridge/protocol.py with one external co-process. Every bridged
+node is a first-class SimNetwork endpoint: loss, partitions, kills, and
+latency apply to its traffic exactly as to in-process nodes' — which makes
+the server a conformance harness for ANY external SWIM implementation (the
+reference's Haskell core behind a socket-writing `Swim.Transport` instance
+would plug in here unchanged; SURVEY.md §2 "Host bridge").
+
+Determinism: virtual time advances only inside STEP handling, on the
+server's single service thread, so a (server seed, client script) pair
+replays identically.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from swim_tpu.bridge import protocol as bp
+from swim_tpu.config import SwimConfig
+from swim_tpu.core.clock import SimClock
+from swim_tpu.core.node import Node
+from swim_tpu.core.transport import Address, InProcessTransport, SimNetwork
+
+
+class BridgeServer:
+    def __init__(self, cfg: SwimConfig, n_internal: int, seed: int = 0,
+                 loss: float = 0.0, host: str = "127.0.0.1", port: int = 0):
+        self.cfg = cfg
+        self.clock = SimClock()
+        self.network = SimNetwork(self.clock, seed=seed, loss=loss)
+        self.nodes: list[Node] = []
+        for i in range(n_internal):
+            t = InProcessTransport(self.network, i)
+            self.nodes.append(Node(cfg, i, t, self.clock, seed=seed * 7919 + i))
+        self._outbox: list[tuple[int, int, bytes]] = []   # (src, dst, bytes)
+        self._bridged: dict[int, InProcessTransport] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(1)
+        self.address: Address = self._sock.getsockname()
+        self._thread: threading.Thread | None = None
+        self._started = False
+
+    # ---------------------------------------------------------------- server
+
+    def start(self) -> None:
+        """Start internal nodes (bootstrapped full-mesh) + service thread."""
+        members = [(n.id, n.transport.local_address) for n in self.nodes]
+        for n in self.nodes:
+            n.bootstrap(members)
+            n.start()
+        self._started = True
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        conn, _ = self._sock.accept()
+        try:
+            self._serve_conn(conn)
+        finally:
+            conn.close()
+            self._sock.close()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        while True:
+            f = bp.read_frame(conn)
+            if f is None or f.op == bp.BYE:
+                return
+            if f.op == bp.HELLO:
+                if self._attach(f.a):
+                    bp.write_frame(conn, bp.Frame(bp.WELCOME, a=f.a,
+                                                  t=self.clock.now()))
+                else:
+                    bp.write_frame(conn, bp.Frame(bp.ERROR,
+                                                  a=bp.ERR_ID_TAKEN))
+            elif f.op == bp.SEND:
+                # the bridged node's endpoint sends: same faults as anyone
+                ep = self._bridged.get(f.a)
+                if ep is not None:
+                    ep.send(("sim", f.b), f.payload)
+            elif f.op == bp.STEP:
+                self.clock.advance(f.t)
+                out, self._outbox = self._outbox, []
+                for src, dst, payload in out:
+                    bp.write_frame(conn, bp.Frame(bp.DELIVER, a=src, b=dst,
+                                                  payload=payload))
+                bp.write_frame(conn, bp.Frame(bp.TIME, t=self.clock.now()))
+            elif f.op == bp.KILL:
+                self.kill(f.a)
+            elif f.op == bp.SET_LOSS:
+                self.network.set_loss(f.t)
+
+    def _attach(self, node_id: int) -> bool:
+        """Claim an endpoint for an external node; False if the id is
+        taken (claiming an internal node's id would silently hijack its
+        endpoint — the harness must reject that, not swallow it)."""
+        if node_id in self._bridged or any(n.id == node_id
+                                           for n in self.nodes):
+            return False
+        ep = InProcessTransport(self.network, node_id)
+
+        def receiver(src: Address, payload: bytes, _id=node_id):
+            self._outbox.append((src[1], _id, payload))
+
+        ep.set_receiver(receiver)
+        self._bridged[node_id] = ep
+        return True
+
+    # ------------------------------------------------------------- controls
+
+    def kill(self, node_id: int) -> None:
+        self.network.kill(("sim", node_id))
+        for n in self.nodes:
+            if n.id == node_id:
+                n.stop()
+
+    def join(self, timeout: float = 10.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)

@@ -166,6 +166,21 @@ def _cmd_study(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_bridge(args: argparse.Namespace) -> int:
+    from swim_tpu import SwimConfig
+    from swim_tpu.bridge import BridgeServer
+
+    cfg = SwimConfig(n_nodes=max(args.internal + 1, 2),
+                     lifeguard=args.lifeguard)
+    server = BridgeServer(cfg, n_internal=args.internal, seed=args.seed,
+                          loss=args.loss, host=args.host, port=args.port)
+    server.start()
+    print(json.dumps({"listening": list(server.address),
+                      "internal_nodes": args.internal}))
+    server.join(timeout=args.timeout)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="swim-tpu",
@@ -223,6 +238,19 @@ def build_parser() -> argparse.ArgumentParser:
                     default=[2.0, 3.0, 5.0, 8.0])
     st.add_argument("--no-partition", action="store_true")
     st.set_defaults(fn=_cmd_study)
+
+    br = sub.add_parser(
+        "bridge", help="serve a simulated cluster for an external core "
+                       "(swim_tpu/bridge/protocol.py)")
+    br.add_argument("--internal", type=int, default=8,
+                    help="in-process nodes to pre-populate")
+    br.add_argument("--host", default="127.0.0.1")
+    br.add_argument("--port", type=int, default=0)
+    br.add_argument("--seed", type=int, default=0)
+    br.add_argument("--loss", type=float, default=0.0)
+    br.add_argument("--lifeguard", action="store_true")
+    br.add_argument("--timeout", type=float, default=3600.0)
+    br.set_defaults(fn=_cmd_bridge)
     return p
 
 

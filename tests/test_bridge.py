@@ -1,0 +1,109 @@
+"""Bridge co-simulation: an external-process protocol core joins a
+simulated cluster over the TCP lockstep protocol and participates fully
+(join, gossip, failure detection) — the contract the Haskell reference
+core would use (SURVEY.md §2 "Host bridge", §7 step 6)."""
+
+from __future__ import annotations
+
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.bridge import BridgeServer, ExternalNodeHost
+from swim_tpu.bridge import protocol as bp
+from swim_tpu.types import Status
+
+
+def test_frame_roundtrip():
+    frames = [
+        bp.Frame(bp.HELLO, a=100),
+        bp.Frame(bp.WELCOME, a=100, t=12.5),
+        bp.Frame(bp.SEND, a=100, b=3, payload=b"\x01\x02datagram"),
+        bp.Frame(bp.STEP, t=0.25),
+        bp.Frame(bp.DELIVER, a=3, b=100, payload=b""),
+        bp.Frame(bp.TIME, t=99.0),
+        bp.Frame(bp.KILL, a=7),
+        bp.Frame(bp.SET_LOSS, t=0.1),
+        bp.Frame(bp.BYE),
+    ]
+    for f in frames:
+        packed = bp.pack(f)
+        assert bp.unpack(packed[4:]) == f
+
+
+def test_bad_frames_rejected():
+    with pytest.raises(ValueError):
+        bp.unpack(bytes([42]))
+    with pytest.raises(ValueError):
+        bp.pack(bp.Frame(99))
+
+
+def test_claiming_internal_node_id_is_rejected():
+    cfg = SwimConfig(n_nodes=4)
+    server = BridgeServer(cfg, n_internal=3, seed=1)
+    server.start()
+    host = ExternalNodeHost(server.address)
+    try:
+        with pytest.raises(ValueError, match="rejected"):
+            host.add_node(cfg, 0, seeds=[1])   # id 0 is an internal node
+        # server-side endpoint was NOT hijacked
+        assert server.network._endpoints[("sim", 0)] \
+            is server.nodes[0].transport
+    finally:
+        host.close()
+        server.join()
+
+
+def test_external_node_joins_and_detects_failures():
+    cfg = SwimConfig(n_nodes=9)  # sizing only (timeout/log-N scaling)
+    server = BridgeServer(cfg, n_internal=8, seed=3)
+    server.start()
+
+    host = ExternalNodeHost(server.address, quantum=0.25)
+    try:
+        ext = host.add_node(cfg, 100, seeds=[0], seed=100)
+        host.run(10.0)
+
+        # the external core joined: it knows everyone, everyone knows it
+        assert len(ext.members) == 9
+        for n in server.nodes:
+            op = n.members.opinion(100)
+            assert op is not None and op.status == Status.ALIVE, n.id
+
+        # fault injection through the bridge: kill an internal node
+        host.kill(3)
+        host.run(45.0)
+        op = ext.members.opinion(3)
+        assert op is not None and op.status == Status.DEAD
+        for n in server.nodes:
+            if n.id == 3:
+                continue
+            op = n.members.opinion(3)
+            assert op is not None and op.status == Status.DEAD, n.id
+
+        # and the external node is still considered alive by everyone
+        for n in server.nodes:
+            if n.id == 3:
+                continue
+            assert n.members.opinion(100).status == Status.ALIVE, n.id
+    finally:
+        host.close()
+        server.join()
+
+
+def test_external_node_crash_is_detected_by_cluster():
+    cfg = SwimConfig(n_nodes=5)
+    server = BridgeServer(cfg, n_internal=4, seed=11)
+    server.start()
+    host = ExternalNodeHost(server.address, quantum=0.25)
+    try:
+        host.add_node(cfg, 100, seeds=[0], seed=100)
+        host.run(8.0)
+        # crash the EXTERNAL node (stops responding; server network drops it)
+        host.kill(100)
+        host.run(45.0)
+        for n in server.nodes:
+            op = n.members.opinion(100)
+            assert op is not None and op.status == Status.DEAD, n.id
+    finally:
+        host.close()
+        server.join()

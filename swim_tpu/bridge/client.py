@@ -65,7 +65,8 @@ class ExternalNodeHost:
         f = bp.read_frame(self._sock)
         if f is None or f.op == bp.ERROR:
             raise ValueError(f"bridge rejected node id {node_id}: {f}")
-        assert f.op == bp.WELCOME, f
+        if f.op != bp.WELCOME:
+            raise ConnectionError(f"expected WELCOME, got {f}")
         self.clock.advance_to(f.t)
         transport = BridgeTransport(self, node_id)
         node = Node(cfg, node_id, transport, self.clock, seed=seed)
@@ -109,7 +110,8 @@ class ExternalNodeHost:
                 if f.op == bp.TIME:
                     now = f.t
                     break
-                assert f.op == bp.DELIVER, f
+                if f.op != bp.DELIVER:
+                    raise ConnectionError(f"unexpected frame mid-step: {f}")
                 deliveries.append(f)
             for d in deliveries:
                 # through the Transport seam — the node registered its

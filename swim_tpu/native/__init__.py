@@ -1,0 +1,64 @@
+"""Native (C++) runtime datapath: wire codec + UDP pump.
+
+The reference is a compiled-native implementation; swim_tpu keeps its
+per-datagram hot path native too. Python owns the protocol state machine,
+C++ owns bytes-on-the-wire:
+
+  * codec.cpp   — encode/decode twin of swim_tpu/core/codec.py,
+  * udppump.cpp — epoll socket pump on a native thread (batch GIL
+    crossings, socket serviced while the interpreter runs protocol logic).
+
+Build-on-first-use via g++ (no pip, no pybind11 — plain C ABI + ctypes),
+cached next to the sources; every consumer falls back to the pure-Python
+path when a toolchain is unavailable, so the native layer is a strict
+acceleration, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+_cache: dict[str, ctypes.CDLL | None] = {}
+
+
+def _load(name: str) -> ctypes.CDLL | None:
+    """Compile (once) and dlopen `name`.cpp; None if no toolchain."""
+    with _LOCK:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        so = os.path.join(_BUILD, f"lib{name}.so")
+        lib: ctypes.CDLL | None = None
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                os.makedirs(_BUILD, exist_ok=True)
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-o", so + ".tmp", src, "-pthread"],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(so + ".tmp", so)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.SubprocessError):
+            lib = None
+        _cache[name] = lib
+        return lib
+
+
+def codec_lib() -> ctypes.CDLL | None:
+    return _load("codec")
+
+
+def pump_lib() -> ctypes.CDLL | None:
+    return _load("udppump")
+
+
+def available() -> dict[str, bool]:
+    return {"codec": codec_lib() is not None,
+            "udppump": pump_lib() is not None}

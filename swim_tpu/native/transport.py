@@ -1,0 +1,124 @@
+"""Native UDP transport: the udppump.cpp datapath behind the Transport ABC.
+
+Same seam as core.transport.UDPTransport (one-per-host deployment), but the
+socket lives on a C++ epoll thread: sends enqueue into the pump's outbox,
+and a drainer polls inbound BATCHES out of the pump — one GIL crossing per
+batch. Pairs with any Clock; delivery callbacks run on the drainer thread
+(the Node runtime is single-threaded per node, so callers running multiple
+nodes drive each from its own transport exactly as with asyncio).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+
+from swim_tpu.core.transport import Address, Receiver, Transport
+from swim_tpu.native import pump_lib
+
+_META_CAP = 1024
+_BUF_CAP = 1 << 20
+
+
+def is_available() -> bool:
+    return pump_lib() is not None
+
+
+class NativeUDPTransport(Transport):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 poll_interval: float = 0.002, loop=None):
+        """`loop`: optional asyncio loop; when given, receiver callbacks
+        are marshalled onto it with call_soon_threadsafe so a Node driven
+        by AsyncioClock sees single-threaded delivery (same contract as
+        core.transport.UDPTransport). Without it, callbacks run on the
+        drainer thread and the caller owns serialization."""
+        lib = pump_lib()
+        if lib is None:
+            raise RuntimeError("native udppump unavailable (no toolchain)")
+        lib.pump_create.restype = ctypes.c_void_p
+        lib.pump_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+        lib.pump_port.restype = ctypes.c_uint16
+        lib.pump_port.argtypes = [ctypes.c_void_p]
+        lib.pump_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint16,
+                                  ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_int]
+        lib.pump_recv.restype = ctypes.c_int
+        lib.pump_recv.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint8),
+                                  ctypes.c_int,
+                                  ctypes.POINTER(ctypes.c_uint32),
+                                  ctypes.c_int]
+        lib.pump_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 3
+        lib.pump_destroy.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.pump_create(host.encode(), port)
+        if not self._h:
+            raise OSError(f"could not bind UDP {host}:{port}")
+        self._local: Address = (host, lib.pump_port(self._h))
+        self._loop = loop
+        self._receiver: Receiver | None = None
+        self._poll_interval = poll_interval
+        self._stop = threading.Event()
+        self._buf = (ctypes.c_uint8 * _BUF_CAP)()
+        self._meta = (ctypes.c_uint32 * (4 * _META_CAP))()
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        import socket as pysock
+
+        while not self._stop.wait(self._poll_interval):
+            n = self._lib.pump_recv(self._h, self._buf, _BUF_CAP,
+                                    self._meta, _META_CAP)
+            if n <= 0 or self._receiver is None:
+                continue
+            off = 0
+            for i in range(n):
+                # meta carries ntohl()'d (host-order) values; big-endian
+                # re-encode recovers network order on any platform
+                ip = pysock.inet_ntoa(
+                    int(self._meta[4 * i]).to_bytes(4, "big"))
+                port = int(self._meta[4 * i + 1])
+                ln = int(self._meta[4 * i + 2])
+                payload = bytes(self._buf[off:off + ln])
+                off += ln
+                if self._loop is not None:
+                    self._loop.call_soon_threadsafe(
+                        self._receiver, (ip, port), payload)
+                else:
+                    self._receiver((ip, port), payload)
+
+    # ------------------------------------------------------------ Transport
+
+    def send(self, to: Address, payload: bytes) -> None:
+        arr = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        self._lib.pump_send(self._h, to[0].encode(), to[1], arr,
+                            len(payload))
+
+    def set_receiver(self, receiver: Receiver) -> None:
+        self._receiver = receiver
+
+    @property
+    def local_address(self) -> Address:
+        return self._local
+
+    def stats(self) -> dict[str, int]:
+        rx = ctypes.c_uint64()
+        tx = ctypes.c_uint64()
+        dr = ctypes.c_uint64()
+        self._lib.pump_stats(self._h, ctypes.byref(rx), ctypes.byref(tx),
+                             ctypes.byref(dr))
+        return {"rx": rx.value, "tx": tx.value, "drops": dr.value}
+
+    def close(self) -> None:
+        if self._h:
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            if self._thread.is_alive():
+                # a wedged receiver callback is still inside pump_recv;
+                # leak the pump rather than free memory under its feet
+                return
+            self._lib.pump_destroy(self._h)
+            self._h = None

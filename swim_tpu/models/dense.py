@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
-from swim_tpu.ops import lattice
+from swim_tpu.ops import lattice, sampling
 from swim_tpu.sim.faults import FaultPlan
 from swim_tpu.utils.prng import PeriodRandomness, draw_period
 
@@ -124,8 +124,17 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     # ---- Phase A: all random choices --------------------------------------
     not_dead = ~lattice.is_dead(key)
     cand = not_dead & (ids[None, :] != ids[:, None])   # bool[N, N]
-    target, has_cand = _masked_pick(cand, rnd.target_u)
-    prober = up & has_cand                             # i sends a W1 ping
+    if cfg.target_selection == "round_robin":
+        # SWIM §4.3 randomized round-robin: each node walks its own
+        # per-epoch Feistel shuffle of the id space; believed-dead targets
+        # are probed and fail fast (docs/PROTOCOL.md §4)
+        epoch = jnp.broadcast_to(t // jnp.int32(n - 1), (n,))
+        pos = jnp.broadcast_to(t % jnp.int32(n - 1), (n,))
+        target = sampling.round_robin_target(ids, epoch, pos, n)
+        prober = up
+    else:
+        target, has_cand = _masked_pick(cand, rnd.target_u)
+        prober = up & has_cand                         # i sends a W1 ping
     cand2 = cand & (ids[None, :] != target[:, None])
     # proxies: k independent picks over cand2 (same row mask per slot)
     c2 = jnp.sum(cand2, axis=-1).astype(jnp.int32)

@@ -131,14 +131,23 @@ class Oracle:
         up = [i for i in range(n) if not self.crashed(i, t)]
 
         # ---- Phase A: all random choices (docs/PROTOCOL.md §4) ----
+        from swim_tpu.ops.sampling import py_round_robin_target
+
+        rr = cfg.target_selection == "round_robin"
+        epoch, pos = divmod(t, n - 1)
         target = {}
         proxies = {}
         for i in up:
             cands = [j for j in range(n)
                      if j != i and key_status(int(st.key[i, j])) != Status.DEAD]
-            if not cands:
-                continue
-            ti = _select_uniform(rnd.target_u[i], cands)
+            if rr:
+                # §4.3 round-robin walks the node's per-epoch Feistel
+                # shuffle; believed-dead targets probed, fail fast
+                ti = py_round_robin_target(i, epoch, pos, n)
+            else:
+                if not cands:
+                    continue
+                ti = _select_uniform(rnd.target_u[i], cands)
             target[i] = ti
             cands2 = [j for j in cands if j != ti]
             if cands2:

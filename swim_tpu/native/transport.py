@@ -11,6 +11,8 @@ nodes drive each from its own transport exactly as with asyncio).
 from __future__ import annotations
 
 import ctypes
+import logging
+import socket as _socket
 import threading
 
 from swim_tpu.core.transport import Address, Receiver, Transport
@@ -57,6 +59,7 @@ class NativeUDPTransport(Transport):
         if not self._h:
             raise OSError(f"could not bind UDP {host}:{port}")
         self._local: Address = (host, lib.pump_port(self._h))
+        self._resolved: dict[str, str] = {}
         self._loop = loop
         self._receiver: Receiver | None = None
         self._poll_interval = poll_interval
@@ -69,6 +72,7 @@ class NativeUDPTransport(Transport):
     def _drain(self) -> None:
         import socket as pysock
 
+        base = ctypes.addressof(self._buf)
         while not self._stop.wait(self._poll_interval):
             n = self._lib.pump_recv(self._h, self._buf, _BUF_CAP,
                                     self._meta, _META_CAP)
@@ -82,20 +86,38 @@ class NativeUDPTransport(Transport):
                     int(self._meta[4 * i]).to_bytes(4, "big"))
                 port = int(self._meta[4 * i + 1])
                 ln = int(self._meta[4 * i + 2])
-                payload = bytes(self._buf[off:off + ln])
+                # string_at: one memcpy, no per-byte boxing
+                payload = ctypes.string_at(base + off, ln)
                 off += ln
-                if self._loop is not None:
-                    self._loop.call_soon_threadsafe(
-                        self._receiver, (ip, port), payload)
-                else:
-                    self._receiver((ip, port), payload)
+                try:
+                    if self._loop is not None:
+                        self._loop.call_soon_threadsafe(
+                            self._receiver, (ip, port), payload)
+                    else:
+                        self._receiver((ip, port), payload)
+                except Exception:  # noqa: BLE001 — a broken handler must
+                    # not kill the drainer and deafen the transport (the
+                    # asyncio path survives handler errors the same way)
+                    logging.getLogger(__name__).exception(
+                        "receiver callback failed; datagram dropped")
 
     # ------------------------------------------------------------ Transport
 
     def send(self, to: Address, payload: bytes) -> None:
+        if not self._h:
+            return  # closed transport: datagram loss is legal on this seam
+        host = to[0]
+        ip = self._resolved.get(host)
+        if ip is None:
+            # the pump takes IPv4 literals only; resolve (and cache) names
+            # so ("localhost", p) seeds behave as with the asyncio path
+            try:
+                ip = _socket.gethostbyname(host)
+            except OSError:
+                return
+            self._resolved[host] = ip
         arr = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        self._lib.pump_send(self._h, to[0].encode(), to[1], arr,
-                            len(payload))
+        self._lib.pump_send(self._h, ip.encode(), to[1], arr, len(payload))
 
     def set_receiver(self, receiver: Receiver) -> None:
         self._receiver = receiver
@@ -105,6 +127,8 @@ class NativeUDPTransport(Transport):
         return self._local
 
     def stats(self) -> dict[str, int]:
+        if not self._h:
+            raise RuntimeError("transport closed")
         rx = ctypes.c_uint64()
         tx = ctypes.c_uint64()
         dr = ctypes.c_uint64()

@@ -93,6 +93,8 @@ struct Pump {
         if (sendto(fd, d.data.data(), d.data.size(), 0, (sockaddr *)&dst,
                    sizeof dst) >= 0)
           tx.fetch_add(1, std::memory_order_relaxed);
+        else  // e.g. EMSGSIZE: a >64K join snapshot exceeds one datagram
+          drops.fetch_add(1, std::memory_order_relaxed);
       }
     }
   }
@@ -126,9 +128,17 @@ void *pump_create(const char *ip, uint16_t port) {
   epoll_event ev{};
   ev.events = EPOLLIN;
   ev.data.fd = p->fd;
-  epoll_ctl(p->epfd, EPOLL_CTL_ADD, p->fd, &ev);
+  bool ok = p->efd >= 0 && p->epfd >= 0 &&
+            epoll_ctl(p->epfd, EPOLL_CTL_ADD, p->fd, &ev) == 0;
   ev.data.fd = p->efd;
-  epoll_ctl(p->epfd, EPOLL_CTL_ADD, p->efd, &ev);
+  ok = ok && epoll_ctl(p->epfd, EPOLL_CTL_ADD, p->efd, &ev) == 0;
+  if (!ok) {  // fd exhaustion etc: fail loudly, not with a deaf handle
+    close(p->fd);
+    if (p->efd >= 0) close(p->efd);
+    if (p->epfd >= 0) close(p->epfd);
+    delete p;
+    return nullptr;
+  }
   p->thr = std::thread([p] { p->loop(); });
   return p;
 }
@@ -138,8 +148,12 @@ uint16_t pump_port(void *h) { return ((Pump *)h)->bound_port; }
 void pump_send(void *h, const char *ip, uint16_t port, const uint8_t *buf,
                int len) {
   auto *p = (Pump *)h;
+  if (p == nullptr) return;
   Dgram d;
-  if (inet_pton(AF_INET, ip, &d.ip) != 1) return;
+  if (inet_pton(AF_INET, ip, &d.ip) != 1) {
+    p->drops.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
   d.port = port;
   d.data.assign(buf, buf + len);
   {

@@ -1,0 +1,122 @@
+"""Randomized round-robin probe-target sampling (SWIM paper §4.3).
+
+The paper's failure detector probes targets in shuffled round-robin order:
+every node visits every other member exactly once per epoch of N−1
+periods, which bounds worst-case detection time at N−1 periods (uniform
+sampling only bounds it in expectation). Materializing a shuffled list per
+node is O(N²) state at simulator scale, so the shuffle is computed, not
+stored: a keyed **format-preserving permutation** of [0, m) built from a
+4-round balanced Feistel network with cycle-walking. Each (node, epoch)
+pair keys its own permutation; evaluating position `t mod m` walks that
+node's shuffled probe list with O(1) state — docs/PROTOCOL.md §4.
+
+Two implementations, bit-identical by construction and by test
+(tests/test_sampling.py): `feistel` on uint32 jnp arrays for the engines,
+`py_feistel` on Python ints for the scalar oracle.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+ROUNDS = 4
+_GOLD = 0x9E3779B9
+
+
+def _half_bits(m: int) -> int:
+    """b such that the 2b-bit Feistel domain covers [0, m)."""
+    if m < 2:
+        return 1
+    return max(1, ((m - 1).bit_length() + 1) // 2)
+
+
+# ---------------------------------------------------------------- jnp path
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """lowbias32 — a well-mixed 32-bit integer hash."""
+    x = jnp.asarray(x, jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def _perm2b(x: jax.Array, b: int, ka: jax.Array, kb: jax.Array) -> jax.Array:
+    mask = jnp.uint32((1 << b) - 1)
+    left = jnp.asarray(x, jnp.uint32) >> b
+    right = jnp.asarray(x, jnp.uint32) & mask
+    for r in range(ROUNDS):
+        rk = _mix32(ka + jnp.uint32((r * _GOLD) & 0xFFFFFFFF)) ^ kb
+        f = _mix32(right + rk) & mask
+        left, right = right, left ^ f
+    return (left << b) | right
+
+
+def feistel(x: jax.Array, m: int, ka: jax.Array, kb: jax.Array) -> jax.Array:
+    """Keyed permutation of [0, m) evaluated at x (elementwise).
+
+    `m` is static; `x`, `ka`, `kb` broadcast. Cycle-walks values that land
+    outside [0, m) (the Feistel domain is the next power of four)."""
+    b = _half_bits(m)
+    mm = jnp.uint32(m)
+    y = _perm2b(x, b, ka, kb)
+
+    def cond(y):
+        return jnp.any(y >= mm)
+
+    def body(y):
+        return jnp.where(y >= mm, _perm2b(y, b, ka, kb), y)
+
+    return jax.lax.while_loop(cond, body, y).astype(jnp.int32)
+
+
+def round_robin_target(node: jax.Array, epoch: jax.Array, pos: jax.Array,
+                       n: int) -> jax.Array:
+    """Probe target of `node` at position `pos` of `epoch` (all [N] arrays).
+
+    Permutes [0, n−1) with a (node, epoch)-derived key, then the skip-self
+    map yields a permutation of the other n−1 members."""
+    node = jnp.asarray(node, jnp.uint32)
+    ka = _mix32(node * jnp.uint32(_GOLD)
+                + jnp.asarray(epoch, jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    kb = _mix32(node ^ (jnp.asarray(epoch, jnp.uint32) + jnp.uint32(1)))
+    p = feistel(jnp.asarray(pos, jnp.uint32), n - 1, ka, kb)
+    return p + (p >= jnp.asarray(node, jnp.int32)).astype(jnp.int32)
+
+
+# ------------------------------------------------------------- python twin
+
+def _py_mix32(x: int) -> int:
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & 0xFFFFFFFF
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & 0xFFFFFFFF
+    return x ^ (x >> 16)
+
+
+def _py_perm2b(x: int, b: int, ka: int, kb: int) -> int:
+    mask = (1 << b) - 1
+    left, right = x >> b, x & mask
+    for r in range(ROUNDS):
+        rk = _py_mix32((ka + r * _GOLD) & 0xFFFFFFFF) ^ kb
+        f = _py_mix32((right + rk) & 0xFFFFFFFF) & mask
+        left, right = right, left ^ f
+    return (left << b) | right
+
+
+def py_feistel(x: int, m: int, ka: int, kb: int) -> int:
+    b = _half_bits(m)
+    y = _py_perm2b(x, b, ka, kb)
+    while y >= m:
+        y = _py_perm2b(y, b, ka, kb)
+    return y
+
+
+def py_round_robin_target(node: int, epoch: int, pos: int, n: int) -> int:
+    ka = _py_mix32((node * _GOLD + epoch * 0x85EBCA6B) & 0xFFFFFFFF)
+    kb = _py_mix32((node ^ ((epoch + 1) & 0xFFFFFFFF)) & 0xFFFFFFFF)
+    p = py_feistel(pos, n - 1, ka, kb)
+    return p + (1 if p >= node else 0)

@@ -29,6 +29,7 @@ from swim_tpu.config import SwimConfig
 from swim_tpu.models import dense, rumor
 from swim_tpu.parallel import mesh as pmesh
 from swim_tpu.sim import faults, runner
+from swim_tpu.utils import metrics
 
 DENSE_MAX = 8192
 
@@ -65,6 +66,7 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
            "engine": engine, "crash_fraction": crash_fraction,
            "suspicion_periods": cfg.suspicion_periods}
     out.update(runner.detection_summary(res, plan, periods))
+    out.update(metrics.series_digest(res.series))
     if engine == "rumor":
         out["overflow"] = int(res.state.overflow)
     return out

@@ -1,0 +1,51 @@
+"""Observability: cluster-level aggregation of per-node runtime stats and
+on-device simulator series.
+
+Two consumers (SURVEY.md §5 "Metrics / logging / observability"):
+
+  * real-node runtime — every `Node` keeps a flat `stats` counter dict;
+    `aggregate_nodes` folds a cluster's worth into totals + health
+    indicators (the reference surfaces the same via stdout/callbacks).
+  * vectorized engines — the study runners already reduce per-period
+    global counters on device (`runner.PeriodSeries`); `series_digest`
+    turns one into a compact host-side summary for logs/JSON.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+
+def aggregate_nodes(nodes: Iterable[Any]) -> dict[str, Any]:
+    """Fold per-node `stats` dicts into cluster totals.
+
+    Adds derived health signals: probe failure rate, refutations (each one
+    is a false suspicion caught in time), and decode errors (wire-level
+    corruption — should be 0 on a healthy transport).
+    """
+    nodes = list(nodes)
+    totals: dict[str, int] = {}
+    for n in nodes:
+        for k, v in n.stats.items():
+            totals[k] = totals.get(k, 0) + v
+    probes = totals.get("probes", 0)
+    out: dict[str, Any] = {"nodes": len(nodes), **totals}
+    out["probe_failure_rate"] = (
+        totals.get("probe_failures", 0) / probes if probes else 0.0)
+    out["messages_per_probe"] = (
+        totals.get("messages_out", 0) / probes if probes else 0.0)
+    if nodes and hasattr(nodes[0], "lha"):
+        out["lha_max"] = max(n.lha for n in nodes)
+    return out
+
+
+def series_digest(series: Any) -> dict[str, Any]:
+    """Compact summary of a runner.PeriodSeries (works for both engines)."""
+    out: dict[str, Any] = {}
+    for name in series._fields:
+        arr = np.asarray(getattr(series, name))
+        out[f"{name}_final"] = int(arr[-1]) if arr.size else 0
+        out[f"{name}_peak"] = int(arr.max()) if arr.size else 0
+    return out

@@ -136,6 +136,34 @@ def test_join_disseminates_by_gossip_not_direct_contact():
     assert knowers == len(c.nodes), f"only {knowers}/24 learned the joiner"
 
 
+def test_metrics_aggregation():
+    from swim_tpu.utils import metrics
+
+    c = SimCluster(stock(12), seed=2)
+    c.start()
+    c.run(15.0)
+    agg = metrics.aggregate_nodes(c.nodes)
+    assert agg["nodes"] == 12
+    assert agg["probes"] > 0
+    assert agg["messages_out"] >= agg["probes"]
+    assert agg["decode_errors"] == 0
+    assert 0.0 <= agg["probe_failure_rate"] <= 1.0
+    # SWIM's constant per-node message load: a probe round is O(1) messages
+    assert agg["messages_per_probe"] < 12.0
+
+
+def test_series_digest():
+    import collections
+
+    import numpy as np
+
+    from swim_tpu.utils import metrics
+
+    S = collections.namedtuple("S", ["a", "b"])
+    d = metrics.series_digest(S(np.array([1, 5, 2]), np.array([], np.int32)))
+    assert d == {"a_final": 2, "a_peak": 5, "b_final": 0, "b_peak": 0}
+
+
 def test_lifeguard_cluster_converges():
     c = SimCluster(stock(16, lifeguard=True), seed=5, loss=0.05)
     c.start()

@@ -94,6 +94,37 @@ def test_piggyback_wider_than_cluster():
     run_both(cfg, plan, seed=9, periods=14)
 
 
+def test_round_robin_parity():
+    """Feistel round-robin target selection (SWIM §4.3) with crashes and
+    loss: the jnp and Python Feistel twins drive identical schedules."""
+    cfg = SwimConfig(n_nodes=22, suspicion_mult=2.0,
+                     target_selection="round_robin")
+    plan = faults.with_loss(faults.none(22), 0.2)
+    plan = faults.with_crashes(plan, [4, 9], [2, 5])
+    run_both(cfg, plan, seed=10, periods=24)
+
+
+def test_round_robin_bounded_detection():
+    """Round-robin bounds first-suspicion worst case: a node crashed at
+    period c is probed by every live node within one epoch (n−1 periods)."""
+    n = 16
+    cfg = SwimConfig(n_nodes=n, target_selection="round_robin")
+    plan = faults.with_crashes(faults.none(n), [7], [2])
+    o = oracle.Oracle(cfg, plan)
+    key = jax.random.key(11)
+    first = None
+    from swim_tpu.types import Status, key_status
+
+    for t in range(2 + n):
+        o.step(prng.to_numpy(prng.draw_period(key, t, cfg)))
+        views = np.asarray(o.state.key)[:, 7]
+        live = [i for i in range(n) if i != 7]
+        if any(key_status(int(views[i])) != Status.ALIVE for i in live):
+            first = t
+            break
+    assert first is not None and first <= 2 + n - 1
+
+
 def test_scan_run_matches_python_loop():
     """dense.run (lax.scan over fused periods) ≡ stepping one at a time."""
     cfg = SwimConfig(n_nodes=16, suspicion_mult=2.0)

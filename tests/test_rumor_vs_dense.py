@@ -76,6 +76,15 @@ class TestExactRegime:
         for t, (dm, rm) in enumerate(views):
             np.testing.assert_array_equal(dm, rm, err_msg=f"period {t}")
 
+    def test_round_robin_views_bitwise_equal(self):
+        """Feistel round-robin schedules are state-independent, so the
+        engines' targets coincide even as views diverge in other fields."""
+        cfg = exact_cfg(40, target_selection="round_robin")
+        plan = faults.with_loss(faults.none(40), 0.2)
+        _, _, views = run_both(cfg, plan, 20)
+        for t, (dm, rm) in enumerate(views):
+            np.testing.assert_array_equal(dm, rm, err_msg=f"period {t}")
+
     def test_pre_confirmation_crash_views_bitwise_equal(self):
         """Crash at t=2: views agree until the first suspicion expiry."""
         cfg = exact_cfg(40)   # suspicion_periods = ceil(8*log10(40)) = 13

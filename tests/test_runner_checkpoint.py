@@ -55,6 +55,27 @@ def test_checkpoint_resume_bitwise(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_checkpoint_resume_bitwise_rumor(tmp_path):
+    """The generic pytree checkpoint also round-trips RumorState (bool
+    heard-bits, uint32 keys, sentinel tables) with bitwise resume."""
+    from swim_tpu.models import rumor
+
+    n = 32
+    cfg = SwimConfig(n_nodes=n, suspicion_mult=2.0, rumor_capacity=64)
+    plan = faults.with_crashes(faults.none(n), [7], [3])
+    key = jax.random.key(5)
+
+    full = rumor.run(cfg, rumor.init_state(cfg), plan, key, 20)
+    half = rumor.run(cfg, rumor.init_state(cfg), plan, key, 10)
+    path = str(tmp_path / "ck.npz")
+    checkpoint.save(path, half, key, 10)
+    restored, rkey, step = checkpoint.restore(path, rumor.init_state(cfg))
+    assert step == 10
+    resumed = rumor.run(cfg, restored, plan, rkey, 10)
+    for a, b in zip(full, resumed):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_checkpoint_manager_rotation(tmp_path):
     cfg = SwimConfig(n_nodes=8)
     st = dense.init_state(cfg)

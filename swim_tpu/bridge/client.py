@@ -8,6 +8,11 @@ works, because the nodes it hosts are complete swim_tpu `Node` protocol
 engines that know nothing about the bridge: they see only a `Clock` and a
 `Transport`, exactly the two seams the reference's typeclass abstracts.
 
+With multiple clients on one server, every client's STEP advances the
+SHARED clock, so a node's worst-case receive lag is ~(n_clients × quantum);
+choose quantum ≪ probe timeout / n_clients when co-simulating several
+processes.
+
 Lockstep loop (per `run(duration)` call, in `quantum`-sized slices):
   1. STEP(dt) → server advances shared virtual time, returns DELIVER
      frames for our nodes and the new TIME,

@@ -36,15 +36,19 @@ class BridgeServer:
         for i in range(n_internal):
             t = InProcessTransport(self.network, i)
             self.nodes.append(Node(cfg, i, t, self.clock, seed=seed * 7919 + i))
-        self._outbox: list[tuple[int, int, bytes]] = []   # (src, dst, bytes)
         self._bridged: dict[int, InProcessTransport] = {}
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(1)
+        self._sock.listen(4)
         self.address: Address = self._sock.getsockname()
         self._thread: threading.Thread | None = None
         self._started = False
+        self._closing = False
+        self._lock = threading.Lock()   # serializes command handling:
+        # virtual time and the network mutate under exactly one client
+        # command at a time, so multi-client co-simulation stays
+        # deterministic given the interleaving of their STEPs
 
     # ---------------------------------------------------------------- server
 
@@ -59,53 +63,105 @@ class BridgeServer:
         self._thread.start()
 
     def _serve(self) -> None:
-        conn, _ = self._sock.accept()
+        """Accept co-process clients until every connected client has hung
+        up (at least one must connect first) or close() fires. Each
+        connection gets a reader thread; command handling serializes on
+        self._lock, so virtual time and the network mutate under exactly
+        one client command at a time — multi-client co-simulation stays
+        deterministic given the interleaving of the clients' STEPs."""
+        self._sock.settimeout(0.2)
+        workers: list[threading.Thread] = []
         try:
-            self._serve_conn(conn)
+            while not self._closing:
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    if workers and not any(w.is_alive() for w in workers):
+                        break
+                    continue
+                except OSError:
+                    break
+                w = threading.Thread(target=self._serve_conn, args=(conn,),
+                                     daemon=True)
+                w.start()
+                workers.append(w)
         finally:
-            conn.close()
             self._sock.close()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        while True:
-            f = bp.read_frame(conn)
-            if f is None or f.op == bp.BYE:
-                return
-            if f.op == bp.HELLO:
-                if self._attach(f.a):
-                    bp.write_frame(conn, bp.Frame(bp.WELCOME, a=f.a,
-                                                  t=self.clock.now()))
-                else:
-                    bp.write_frame(conn, bp.Frame(bp.ERROR,
-                                                  a=bp.ERR_ID_TAKEN))
-            elif f.op == bp.SEND:
-                # the bridged node's endpoint sends: same faults as anyone
-                ep = self._bridged.get(f.a)
-                if ep is not None:
-                    ep.send(("sim", f.b), f.payload)
-            elif f.op == bp.STEP:
-                self.clock.advance(f.t)
-                out, self._outbox = self._outbox, []
-                for src, dst, payload in out:
-                    bp.write_frame(conn, bp.Frame(bp.DELIVER, a=src, b=dst,
-                                                  payload=payload))
-                bp.write_frame(conn, bp.Frame(bp.TIME, t=self.clock.now()))
-            elif f.op == bp.KILL:
-                self.kill(f.a)
-            elif f.op == bp.SET_LOSS:
-                self.network.set_loss(f.t)
+        outbox: list[tuple[int, int, bytes]] = []
+        owned: set[int] = set()
+        try:
+            while True:
+                try:
+                    f = bp.read_frame(conn)
+                except (ValueError, OSError):
+                    return  # torn frame / dead peer: drop this client only
+                if f is None or f.op == bp.BYE:
+                    return
+                with self._lock:
+                    # only wire writes are recoverable here; a protocol-
+                    # engine error inside _handle must propagate loudly,
+                    # not masquerade as a client disconnect
+                    try:
+                        self._handle(conn, f, outbox, owned)
+                    except OSError:
+                        return
+        finally:
+            with self._lock:
+                # a vanished client's nodes must not black-hole traffic or
+                # squat their ids: detach so a reconnect can re-claim
+                for node_id in owned:
+                    ep = self._bridged.pop(node_id, None)
+                    if ep is not None:
+                        self.network.detach(ep.local_address)
+            conn.close()
 
-    def _attach(self, node_id: int) -> bool:
-        """Claim an endpoint for an external node; False if the id is
-        taken (claiming an internal node's id would silently hijack its
-        endpoint — the harness must reject that, not swallow it)."""
+    def _handle(self, conn: socket.socket, f: bp.Frame,
+                outbox: list[tuple[int, int, bytes]],
+                owned: set[int]) -> None:
+        if f.op == bp.HELLO:
+            if self._attach(f.a, outbox):
+                owned.add(f.a)
+                bp.write_frame(conn, bp.Frame(bp.WELCOME, a=f.a,
+                                              t=self.clock.now()))
+            else:
+                bp.write_frame(conn, bp.Frame(bp.ERROR, a=bp.ERR_ID_TAKEN))
+        elif f.op == bp.SEND:
+            # only a connection's own nodes may transmit through it —
+            # multi-client conformance runs must not let one client
+            # attribute traffic to another's implementation. (KILL stays
+            # global on purpose: it is harness fault injection, not node
+            # behavior.) Faults then apply to the send like anyone's.
+            ep = self._bridged.get(f.a) if f.a in owned else None
+            if ep is not None:
+                ep.send(("sim", f.b), f.payload)
+        elif f.op == bp.STEP:
+            self.clock.advance(f.t)
+            out = list(outbox)
+            outbox.clear()
+            for src, dst, payload in out:
+                bp.write_frame(conn, bp.Frame(bp.DELIVER, a=src, b=dst,
+                                              payload=payload))
+            bp.write_frame(conn, bp.Frame(bp.TIME, t=self.clock.now()))
+        elif f.op == bp.KILL:
+            self.kill(f.a)
+        elif f.op == bp.SET_LOSS:
+            self.network.set_loss(f.t)
+
+    def _attach(self, node_id: int,
+                outbox: list[tuple[int, int, bytes]]) -> bool:
+        """Claim an endpoint for an external node, delivering into its
+        owning connection's outbox; False if the id is taken (claiming an
+        internal node's id would silently hijack its endpoint — the
+        harness must reject that, not swallow it)."""
         if node_id in self._bridged or any(n.id == node_id
                                            for n in self.nodes):
             return False
         ep = InProcessTransport(self.network, node_id)
 
         def receiver(src: Address, payload: bytes, _id=node_id):
-            self._outbox.append((src[1], _id, payload))
+            outbox.append((src[1], _id, payload))
 
         ep.set_receiver(receiver)
         self._bridged[node_id] = ep
@@ -118,6 +174,10 @@ class BridgeServer:
         for n in self.nodes:
             if n.id == node_id:
                 n.stop()
+
+    def close(self) -> None:
+        """Stop accepting new clients; existing connections finish."""
+        self._closing = True
 
     def join(self, timeout: float = 10.0) -> None:
         if self._thread is not None:

@@ -109,10 +109,17 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     mod = dense if engine == "dense" else rumor
     state = pmesh.shard_state(mod.init_state(cfg), mesh, n=args.nodes)
     plan = pmesh.shard_state(plan, mesh, n=args.nodes)
+    import contextlib
+
+    from swim_tpu.utils import profiling
+
+    prof = (profiling.trace(args.profile) if args.profile
+            else contextlib.nullcontext())
     t0 = time.perf_counter()
-    state = mod.run(cfg, state, plan, jax.random.key(args.seed),
-                    args.periods)
-    jax.block_until_ready(state)
+    with prof:
+        state = mod.run(cfg, state, plan, jax.random.key(args.seed),
+                        args.periods)
+        jax.block_until_ready(state)
     dt = time.perf_counter() - t0
 
     crashed = np.asarray(plan.crash_step) <= args.periods
@@ -219,6 +226,8 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--lifeguard", action="store_true")
     sim.add_argument("--engine", choices=("auto", "dense", "rumor"),
                      default="auto")
+    sim.add_argument("--profile", default="",
+                     help="write a jax.profiler device trace to this dir")
     sim.set_defaults(fn=_cmd_simulate)
 
     st = sub.add_parser(

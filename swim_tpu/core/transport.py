@@ -62,6 +62,7 @@ class SimNetwork:
         self.rng = random.Random(seed)
         self.loss = loss
         self.latency = latency
+        self._link_latency: dict[frozenset[Address], float] = {}
         self._endpoints: dict[Address, "InProcessTransport"] = {}
         self._cut: set[frozenset[Address]] = set()
         self._down: set[Address] = set()
@@ -71,10 +72,20 @@ class SimNetwork:
     def attach(self, ep: "InProcessTransport") -> None:
         self._endpoints[ep.local_address] = ep
 
+    def detach(self, addr: Address) -> None:
+        """Remove an endpoint; traffic to it is dropped from now on."""
+        self._endpoints.pop(addr, None)
+
     # -- fault injection ----------------------------------------------------
 
     def set_loss(self, loss: float) -> None:
         self.loss = loss
+
+    def set_link_latency(self, a: Address, b: Address,
+                         seconds: float) -> None:
+        """Override the default latency for one (undirected) link — e.g. a
+        slow WAN pair in an otherwise-LAN cluster."""
+        self._link_latency[frozenset((a, b))] = seconds
 
     def cut(self, a: Address, b: Address) -> None:
         self._cut.add(frozenset((a, b)))
@@ -115,7 +126,8 @@ class SimNetwork:
             if ep._receiver is not None:
                 ep._receiver(src, payload)
 
-        self.clock.call_later(self.latency, deliver)
+        lat = self._link_latency.get(frozenset((src, dst)), self.latency)
+        self.clock.call_later(lat, deliver)
 
 
 class InProcessTransport(Transport):

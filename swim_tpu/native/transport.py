@@ -60,6 +60,7 @@ class NativeUDPTransport(Transport):
             raise OSError(f"could not bind UDP {host}:{port}")
         self._local: Address = (host, lib.pump_port(self._h))
         self._resolved: dict[str, str] = {}
+        self._hlock = threading.Lock()  # orders send/stats against close
         self._loop = loop
         self._receiver: Receiver | None = None
         self._poll_interval = poll_interval
@@ -117,7 +118,11 @@ class NativeUDPTransport(Transport):
                 return
             self._resolved[host] = ip
         arr = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-        self._lib.pump_send(self._h, ip.encode(), to[1], arr, len(payload))
+        with self._hlock:
+            if not self._h:
+                return
+            self._lib.pump_send(self._h, ip.encode(), to[1], arr,
+                                len(payload))
 
     def set_receiver(self, receiver: Receiver) -> None:
         self._receiver = receiver
@@ -127,22 +132,26 @@ class NativeUDPTransport(Transport):
         return self._local
 
     def stats(self) -> dict[str, int]:
-        if not self._h:
-            raise RuntimeError("transport closed")
         rx = ctypes.c_uint64()
         tx = ctypes.c_uint64()
         dr = ctypes.c_uint64()
-        self._lib.pump_stats(self._h, ctypes.byref(rx), ctypes.byref(tx),
-                             ctypes.byref(dr))
+        with self._hlock:
+            if not self._h:
+                raise RuntimeError("transport closed")
+            self._lib.pump_stats(self._h, ctypes.byref(rx), ctypes.byref(tx),
+                                 ctypes.byref(dr))
         return {"rx": rx.value, "tx": tx.value, "drops": dr.value}
 
     def close(self) -> None:
-        if self._h:
-            self._stop.set()
-            self._thread.join(timeout=5.0)
-            if self._thread.is_alive():
-                # a wedged receiver callback is still inside pump_recv;
-                # leak the pump rather than free memory under its feet
-                return
-            self._lib.pump_destroy(self._h)
-            self._h = None
+        if not self._h:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if self._thread.is_alive():
+            # a wedged receiver callback is still inside pump_recv;
+            # leak the pump rather than free memory under its feet
+            return
+        with self._hlock:
+            h, self._h = self._h, None
+        if h:
+            self._lib.pump_destroy(h)

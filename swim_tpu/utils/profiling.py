@@ -1,0 +1,65 @@
+"""Tracing / profiling (SURVEY.md §5): device traces + step timing.
+
+Two tools:
+
+  * `trace(logdir)` — context manager around `jax.profiler` producing a
+    TensorBoard-loadable device trace of whatever runs inside (the
+    per-period wave structure of the engines shows up as named XLA ops).
+  * `StepTimer` — wall-clock periods/sec tracking with `block_until_ready`
+    fencing, for quick numbers without a trace viewer. This is what
+    bench.py's measurement loop does, packaged for library users.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax.profiler device trace into `logdir`."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Measure protocol-periods/sec over explicit laps.
+
+    >>> timer = StepTimer()
+    >>> with timer.lap(periods=50):
+    ...     state = engine.run(50)        # doctest: +SKIP
+    >>> timer.periods_per_sec             # doctest: +SKIP
+    """
+
+    def __init__(self):
+        self.periods = 0
+        self.seconds = 0.0
+
+    @contextlib.contextmanager
+    def lap(self, periods: int, result: Any = None):
+        t0 = time.perf_counter()
+        holder = {}
+        try:
+            yield holder
+        finally:
+            out = holder.get("result", result)
+            if out is not None:
+                jax.block_until_ready(out)
+            self.seconds += time.perf_counter() - t0
+            self.periods += periods
+
+    @property
+    def periods_per_sec(self) -> float:
+        return self.periods / self.seconds if self.seconds else 0.0
+
+    def summary(self) -> dict[str, float]:
+        return {"periods": float(self.periods),
+                "seconds": round(self.seconds, 4),
+                "periods_per_sec": round(self.periods_per_sec, 2)}

@@ -90,6 +90,67 @@ def test_external_node_joins_and_detects_failures():
         server.join()
 
 
+def test_disconnect_releases_node_ids():
+    """A vanished client's ids are detached (no black-holed traffic) and
+    re-claimable by a reconnecting client."""
+    cfg = SwimConfig(n_nodes=6)
+    server = BridgeServer(cfg, n_internal=4, seed=2)
+    server.start()
+    h1 = ExternalNodeHost(server.address, quantum=0.25)
+    h1.add_node(cfg, 100, seeds=[0], seed=1)
+    h1.run(2.0)
+    h1.close()          # simulated crash/disconnect
+    import time
+
+    deadline = time.time() + 5.0
+    h2 = None
+    while time.time() < deadline:
+        try:
+            h2 = ExternalNodeHost(server.address, quantum=0.25)
+            h2.add_node(cfg, 100, seeds=[0], seed=2)  # re-claim same id
+            break
+        except (ValueError, ConnectionError, OSError):
+            if h2 is not None:
+                h2.close()
+                h2 = None
+            time.sleep(0.1)
+    assert h2 is not None, "reconnect could not re-claim node id 100"
+    h2.run(2.0)
+    h2.close()
+    server.join()
+
+
+def test_two_external_processes_cosimulate():
+    """Two independent bridge clients (two co-processes) each contribute a
+    node; both join, see each other, and share failure detection."""
+    cfg = SwimConfig(n_nodes=8)
+    server = BridgeServer(cfg, n_internal=6, seed=21)
+    server.start()
+    # with C clients a node's worst-case receive lag is ~C×quantum
+    # (each client's STEP advances the shared clock); keep that well
+    # under the 0.3-period direct-probe timeout
+    h1 = ExternalNodeHost(server.address, quantum=0.05)
+    h2 = ExternalNodeHost(server.address, quantum=0.05)
+    try:
+        e1 = h1.add_node(cfg, 100, seeds=[0], seed=100)
+        e2 = h2.add_node(cfg, 200, seeds=[1], seed=200)
+        for _ in range(100):    # interleaved lockstep: 10s virtual total
+            h1.run(0.05)
+            h2.run(0.05)
+        assert e1.members.opinion(200).status == Status.ALIVE
+        assert e2.members.opinion(100).status == Status.ALIVE
+        h1.kill(3)
+        for _ in range(220):
+            h1.run(0.05)
+            h2.run(0.05)
+        assert e1.members.opinion(3).status == Status.DEAD
+        assert e2.members.opinion(3).status == Status.DEAD
+    finally:
+        h1.close()
+        h2.close()
+        server.join()
+
+
 def test_external_node_crash_is_detected_by_cluster():
     cfg = SwimConfig(n_nodes=5)
     server = BridgeServer(cfg, n_internal=4, seed=11)

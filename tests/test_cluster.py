@@ -164,6 +164,24 @@ def test_series_digest():
     assert d == {"a_final": 2, "a_peak": 5, "b_final": 0, "b_peak": 0}
 
 
+def test_step_timer_and_trace(tmp_path):
+    import jax.numpy as jnp
+
+    from swim_tpu.utils import profiling
+
+    timer = profiling.StepTimer()
+    with timer.lap(periods=10) as h:
+        h["result"] = jnp.arange(8) * 2
+    assert timer.periods == 10
+    assert timer.periods_per_sec > 0
+    assert timer.summary()["periods"] == 10.0
+
+    with profiling.trace(str(tmp_path / "trace")):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    import os
+    assert any("plugins" in r or f for r, d, f in os.walk(tmp_path))
+
+
 def test_lifeguard_cluster_converges():
     c = SimCluster(stock(16, lifeguard=True), seed=5, loss=0.05)
     c.start()

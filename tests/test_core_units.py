@@ -158,6 +158,26 @@ class TestSimClock:
         assert fired == [1.0, 2.0, 3.0]
 
 
+class TestSimNetworkLatency:
+    def test_per_link_latency_override(self):
+        from swim_tpu.core.clock import SimClock
+        from swim_tpu.core.transport import InProcessTransport, SimNetwork
+
+        clock = SimClock()
+        net = SimNetwork(clock, latency=0.001)
+        a = InProcessTransport(net, 0)
+        b = InProcessTransport(net, 1)
+        got = []
+        b.set_receiver(lambda src, p: got.append((clock.now(), p)))
+        net.set_link_latency(a.local_address, b.local_address, 0.5)
+        a.send(b.local_address, b"slow")
+        clock.advance(0.01)
+        assert got == []            # default latency would have delivered
+        clock.advance(0.5)
+        assert got and got[0][1] == b"slow"
+        assert abs(got[0][0] - 0.5) < 1e-9
+
+
 class TestJoinSnapshot:
     def test_large_snapshot_chunks_across_datagrams(self):
         """>255 members must not blow the codec's gossip cap (chunked)."""

@@ -133,6 +133,26 @@ class TestStatisticalRegime:
         assert 1.0 <= float(np.mean(lat)) <= 4.0
 
 
+class TestPaperFidelity:
+    def test_dissemination_scales_logarithmically(self):
+        """Infection-style gossip reaches all N nodes in O(log N) periods
+        (SWIM paper): dissemination latency must grow far slower than N —
+        quadrupling N should add only a few periods, nowhere near 4x."""
+        lat = {}
+        for n in (64, 256):
+            cfg = SwimConfig(n_nodes=n, suspicion_mult=2.0)
+            plan = faults.with_crashes(faults.none(n), [n // 2], [2])
+            res = runner.run_study_rumor(cfg, rumor.init_state(cfg), plan,
+                                         jax.random.key(4), 80)
+            t = int(np.asarray(res.track.disseminated)[n // 2])
+            assert t != int(runner.NEVER), n
+            lat[n] = t - 2
+        # 4x the nodes: latency grows by the suspicion-timeout delta
+        # (ceil(2·log10 N)) plus O(log N) gossip hops, not by 4x
+        assert lat[256] <= lat[64] + 8, lat
+        assert lat[256] < 4 * lat[64], lat
+
+
 class TestInvariants:
     def test_clean_network_stays_rumor_free(self):
         cfg = SwimConfig(n_nodes=64)

@@ -1,15 +1,33 @@
 """Benchmark: simulated protocol-periods/sec (BASELINE.md primary metric).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} and
+ALWAYS exits 0 with that line present, whatever the backend does.
 
 The north-star target (BASELINE.json) is 10,000 protocol-periods/sec at 1M
 virtual nodes on a v5e-8. `vs_baseline` reports value / 10_000 — i.e. the
 fraction of that target achieved on the hardware this run sees, at the
 headline configuration (1M nodes, rumor engine, 0.1% crash churn).
 
-Two tiers, mirroring the two engines:
-  * dense  — exact O(N²) engine at N=4096 (its sweet spot),
-  * rumor  — scalable O(R·N) engine at N=1,000,000 (the headline).
+Resilience design (VERDICT r1 Weak #2: one backend-init exception killed the
+whole run with rc=1 and no JSON; the axon TPU backend has also been observed
+to HANG in jax.devices() for 300+ s):
+
+  * The ambient TPU backend is probed in a SUBPROCESS with a bounded
+    timeout; a hung or broken backend can never take the parent down.
+  * Each tier runs in its own bounded subprocess (`--_tier` child mode);
+    a compile hang or OOM in one tier is contained and recorded.
+  * The parent composes partial results and always prints the JSON line.
+
+Platform selection: --platform auto (default) probes the default backend
+(the sandbox pins JAX_PLATFORMS=axon) and falls back to an 8-device virtual
+CPU mesh; axon/tpu/cpu force a choice. The child forces CPU in-process via
+jax.config.update, which wins over the sitecustomize pin.
+
+Tiers (mirroring the two engines):
+  * dense — exact O(N^2) engine at N=4096 (its sweet spot),
+  * rumor — scalable O(R*N) engine at N=1,000,000 (the headline),
+  * shard — explicitly-sharded rumor engine (shard_map + compact
+    exchanges), same headline N, used when it beats GSPMD.
 
 Run with --smoke for a fast correctness pass (small N, few periods), or
 --tier dense|rumor|both to pick (default: headline rumor tier only).
@@ -19,15 +37,56 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-
 TARGET_PERIODS_PER_SEC = 10_000.0
+CPU_FALLBACK_DEVICES = 8
 
+
+# --------------------------------------------------------------------------
+# Platform handling (no jax import at module scope: the import is deferred
+# until the platform decision is made, because backend init follows the
+# first device query and cannot be undone).
+# --------------------------------------------------------------------------
+
+def probe_default_backend(timeout: float) -> tuple[str | None, str]:
+    """Try `jax.devices()` on the ambient platform in a subprocess.
+
+    Returns (platform_name | None, detail). A hung init (observed: 300+ s
+    in round 1) is just a timeout here, not a lost benchmark.
+    """
+    code = ("import jax; d = jax.devices(); "
+            "print(d[0].platform, len(d))")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, f"backend probe timed out after {timeout:.0f}s"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()
+        return None, (tail[-1] if tail else f"probe rc={r.returncode}")
+    detail = r.stdout.strip()
+    return (detail.split() or ["unknown"])[0], detail
+
+
+def force_cpu_platform(n_devices: int = CPU_FALLBACK_DEVICES) -> None:
+    """Force the virtual multi-device CPU platform (in-process)."""
+    from swim_tpu.utils.platform import force_cpu
+
+    force_cpu(n_devices)
+
+
+# --------------------------------------------------------------------------
+# Tier bodies (child process only)
+# --------------------------------------------------------------------------
 
 def _time_run(run, state, warmup: int, periods: int) -> float:
+    import jax
+
     for _ in range(warmup):
         jax.block_until_ready(run(state))
     t0 = time.perf_counter()
@@ -37,6 +96,8 @@ def _time_run(run, state, warmup: int, periods: int) -> float:
 
 
 def bench_dense(n_nodes: int, periods: int, warmup: int = 2) -> float:
+    import jax
+
     from swim_tpu import SwimConfig
     from swim_tpu.models import dense
     from swim_tpu.parallel import mesh as pmesh
@@ -60,6 +121,8 @@ def bench_rumor(n_nodes: int, periods: int, warmup: int = 2,
                 rumor_capacity: int = 256,
                 crash_fraction: float = 0.001) -> float:
     """Headline tier: detection workload (crash churn) at simulator scale."""
+    import jax
+
     from swim_tpu import SwimConfig
     from swim_tpu.models import rumor
     from swim_tpu.parallel import mesh as pmesh
@@ -80,42 +143,179 @@ def bench_rumor(n_nodes: int, periods: int, warmup: int = 2,
     return _time_run(run, state, warmup, periods)
 
 
+def bench_shard(n_nodes: int, periods: int, warmup: int = 1,
+                rumor_capacity: int = 256,
+                crash_fraction: float = 0.001) -> float:
+    """Explicitly-sharded rumor engine (shard_map + compact exchanges)."""
+    import jax
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import rumor
+    from swim_tpu.parallel import mesh as pmesh, shard_engine
+    from swim_tpu.sim import faults
+
+    cfg = SwimConfig(n_nodes=n_nodes, rumor_capacity=rumor_capacity)
+    mesh = pmesh.make_mesh()
+    plan = faults.with_random_crashes(
+        faults.none(n_nodes), jax.random.key(1), crash_fraction,
+        0, max(periods, 1))
+    state, plan = shard_engine.place(cfg, mesh, rumor.init_state(cfg), plan)
+    run = shard_engine.build_run(cfg, mesh, periods)
+    key = jax.random.key(0)
+
+    def go(st):
+        return run(st, plan, key)
+
+    return _time_run(go, state, warmup, periods)
+
+
+TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
+            "shard": bench_shard}
+
+
+def run_tier_child(args) -> int:
+    """Child mode: run one tier on the decided platform, print JSON."""
+    if args.platform == "cpu":
+        force_cpu_platform()
+    elif args.platform in ("axon", "tpu"):
+        # an explicit accelerator request must not silently run elsewhere
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    # else ("default"/"auto"): leave the ambient platform alone.
+    try:
+        pps = TIER_FNS[args._tier](args.nodes, args.periods)
+        print(json.dumps({"ok": True, "tier": args._tier,
+                          "nodes": args.nodes, "periods": args.periods,
+                          "periods_per_sec": round(pps, 2)}))
+        return 0
+    except Exception as e:  # noqa: BLE001 — the whole point is containment
+        print(json.dumps({"ok": False, "tier": args._tier,
+                          "nodes": args.nodes,
+                          "error": f"{type(e).__name__}: {e}"[:500]}))
+        return 0
+
+
+# --------------------------------------------------------------------------
+# Parent orchestration
+# --------------------------------------------------------------------------
+
+def run_tier(tier: str, platform: str, nodes: int, periods: int,
+             timeout: float) -> dict:
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--_tier", tier, "--platform", platform,
+           "--nodes", str(nodes), "--periods", str(periods)]
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True, cwd=os.path.dirname(
+                               os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "tier": tier, "nodes": nodes,
+                "error": f"tier timed out after {timeout:.0f}s"}
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            out = json.loads(line)
+            if isinstance(out, dict) and "ok" in out:
+                return out
+        except json.JSONDecodeError:
+            continue
+    tail = ((r.stderr or "").strip().splitlines() or ["no output"])[-1]
+    return {"ok": False, "tier": tier, "nodes": nodes,
+            "error": f"tier rc={r.returncode}: {tail}"[:500]}
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
-    ap.add_argument("--tier", choices=("dense", "rumor", "both"),
-                    default="rumor")
+    ap.add_argument("--tier", default="rumor",
+                    choices=("dense", "rumor", "shard", "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
+    ap.add_argument("--platform", default="auto",
+                    choices=("auto", "default", "axon", "tpu", "cpu"))
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--tier-timeout", type=float, default=1200.0)
+    ap.add_argument("--_tier", default=None, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
+    if args._tier:  # child mode
+        return run_tier_child(args)
+
+    info: dict = {}
+    if args.platform == "auto":
+        probed, detail = probe_default_backend(args.probe_timeout)
+        info["backend_probe"] = detail
+        if probed in (None, "cpu"):
+            # broken backend OR this machine's default IS the CPU: either
+            # way the child forces the virtual CPU mesh and tiers size
+            # for CPU throughput
+            platform = "cpu"
+            if probed is None:
+                info["fallback"] = "cpu"
+        else:
+            platform = "default"  # healthy accelerator: leave it alone
+            info["accelerator"] = probed
+    else:
+        platform = args.platform
+    on_tpu = platform not in ("cpu",)
+
+    # Tier sizing: headline numbers on the real chip; CPU fallback shrinks
+    # N so the benchmark still completes and reports honestly.
     if args.smoke:
         n_r, n_d, periods = 4096, 128, 8
-    else:
+    elif on_tpu:
         n_r = args.nodes or 1_000_000
         n_d = min(args.nodes or 4096, 8192)
         periods = args.periods or 50
-
-    extras = {}
-    if args.tier in ("dense", "both"):
-        dense_pps = bench_dense(n_d, max(periods, 50))
-        extras["dense"] = (n_d, dense_pps)
-    if args.tier in ("rumor", "both"):
-        pps = bench_rumor(n_r, periods)
-        n_head = n_r
     else:
-        n_head, pps = extras["dense"]
+        n_r = args.nodes or 65_536
+        n_d = min(args.nodes or 1024, 2048)
+        periods = args.periods or 20
+
+    tiers = {"both": ["dense", "rumor"],
+             "all": ["dense", "rumor", "shard"]}.get(args.tier, [args.tier])
+    results = {}
+    for tier in tiers:
+        nodes = n_d if tier == "dense" else n_r
+        p = max(periods, 50) if (tier == "dense" and not args.smoke) \
+            else periods
+        results[tier] = run_tier(tier, platform, nodes, p,
+                                 args.tier_timeout)
+
+    # Headline: the best SCALABLE-engine number (shard/rumor at headline N);
+    # dense is a fallback only when no scalable tier succeeded — its small-N
+    # exact-engine pps is not comparable to the 1M-node target.
+    head_tier, head = None, None
+    for tier in ("shard", "rumor"):
+        r = results.get(tier)
+        if r and r.get("ok"):
+            if head is None or r["periods_per_sec"] > head["periods_per_sec"]:
+                head, head_tier = r, tier
+    if head is None and results.get("dense", {}).get("ok"):
+        head, head_tier = results["dense"], "dense"
+    if head is not None:
+        value = head["periods_per_sec"]
+        metric = (f"simulated protocol-periods/sec @ {head['nodes']} nodes "
+                  f"({head_tier} engine, {platform})")
+    else:
+        value = 0.0
+        metric = f"simulated protocol-periods/sec (all tiers failed, {platform})"
+        info["errors"] = {t: r.get("error") for t, r in results.items()}
 
     out = {
-        "metric": f"simulated protocol-periods/sec @ {n_head} nodes "
-                  f"({'rumor' if args.tier != 'dense' else 'dense'} engine)",
-        "value": round(pps, 2),
+        "metric": metric,
+        "value": value,
         "unit": "periods/sec",
-        "vs_baseline": round(pps / TARGET_PERIODS_PER_SEC, 4),
+        "vs_baseline": round(value / TARGET_PERIODS_PER_SEC, 4),
+        "platform": platform,
     }
-    if "dense" in extras and args.tier == "both":
-        out["dense_nodes"] = extras["dense"][0]
-        out["dense_periods_per_sec"] = round(extras["dense"][1], 2)
+    for tier, r in results.items():
+        if r.get("ok"):
+            out[f"{tier}_nodes"] = r["nodes"]
+            out[f"{tier}_periods_per_sec"] = r["periods_per_sec"]
+        else:
+            out[f"{tier}_error"] = r.get("error")
+    out.update(info)
     print(json.dumps(out))
     return 0
 

@@ -106,9 +106,23 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
             plan, jax.random.key(args.seed + 1), args.crash_fraction,
             0, max(1, args.periods // 2))
     mesh = pmesh.make_mesh()
-    mod = dense if engine == "dense" else rumor
-    state = pmesh.shard_state(mod.init_state(cfg), mesh, n=args.nodes)
-    plan = pmesh.shard_state(plan, mesh, n=args.nodes)
+    if engine == "shard":
+        from swim_tpu.parallel import shard_engine
+
+        state, plan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
+                                         plan)
+        run_fn = shard_engine.build_run(cfg, mesh, args.periods)
+
+        def do_run(st):
+            return run_fn(st, plan, jax.random.key(args.seed))
+    else:
+        mod = dense if engine == "dense" else rumor
+        state = pmesh.shard_state(mod.init_state(cfg), mesh, n=args.nodes)
+        plan = pmesh.shard_state(plan, mesh, n=args.nodes)
+
+        def do_run(st):
+            return mod.run(cfg, st, plan, jax.random.key(args.seed),
+                           args.periods)
     import contextlib
 
     from swim_tpu.utils import profiling
@@ -117,8 +131,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
             else contextlib.nullcontext())
     t0 = time.perf_counter()
     with prof:
-        state = mod.run(cfg, state, plan, jax.random.key(args.seed),
-                        args.periods)
+        state = do_run(state)
         jax.block_until_ready(state)
     dt = time.perf_counter() - t0
 
@@ -193,6 +206,11 @@ def build_parser() -> argparse.ArgumentParser:
         prog="swim-tpu",
         description="TPU-native SWIM failure-detection framework & simulator",
     )
+    p.add_argument("--platform", default="default",
+                   choices=("default", "cpu", "cpu8"),
+                   help="JAX platform: 'cpu' forces the host CPU backend "
+                        "(survives a broken TPU tunnel), 'cpu8' adds an "
+                        "8-device virtual mesh for sharding work")
     sub = p.add_subparsers(dest="command", required=True)
 
     info = sub.add_parser("info", help="show derived protocol constants")
@@ -224,7 +242,7 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--crash-fraction", type=float, default=0.01)
     sim.add_argument("--suspicion-mult", type=float, default=5.0)
     sim.add_argument("--lifeguard", action="store_true")
-    sim.add_argument("--engine", choices=("auto", "dense", "rumor"),
+    sim.add_argument("--engine", choices=("auto", "dense", "rumor", "shard"),
                      default="auto")
     sim.add_argument("--profile", default="",
                      help="write a jax.profiler device trace to this dir")
@@ -237,7 +255,7 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--nodes", type=int, default=1000)
     st.add_argument("--periods", type=int, default=100)
     st.add_argument("--seed", type=int, default=0)
-    st.add_argument("--engine", choices=("auto", "dense", "rumor"),
+    st.add_argument("--engine", choices=("auto", "dense", "rumor", "shard"),
                     default="auto")
     st.add_argument("--crash-fraction", type=float, default=0.01)
     st.add_argument("--loss", type=float, default=0.05)
@@ -266,6 +284,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.platform != "default":
+        from swim_tpu.utils.platform import force_cpu
+
+        force_cpu(8 if args.platform == "cpu8" else None)
     return args.fn(args)
 
 

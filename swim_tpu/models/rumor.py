@@ -112,6 +112,25 @@ def _budget(cfg: SwimConfig) -> int:
     return min(cfg.rumor_slots, 256)
 
 
+def dynamic_timeout_py(cfg: SwimConfig, filled: int) -> int:
+    """Lifeguard dynamic suspicion timeout for `filled` sentinels (plain
+    Python ints — the single definition shared by the engines' trace-time
+    table and the scalar rumor oracle)."""
+    import math
+
+    base_to = float(cfg.suspicion_periods)
+    max_to = float(cfg.suspicion_max_periods)
+    c_tot = float(cfg.k_indirect + 1)
+    frac = math.log(max(float(filled), 1.0)) / math.log(c_tot + 1.0)
+    return int(math.ceil(max(base_to, max_to - (max_to - base_to) * frac)))
+
+
+def dynamic_timeout_table(cfg: SwimConfig) -> jax.Array:
+    """i32[S+1]: timeout per filled-sentinel count, built at trace time."""
+    return jnp.asarray([dynamic_timeout_py(cfg, f)
+                        for f in range(cfg.sentinels + 1)], jnp.int32)
+
+
 def _pig_window(cfg: SwimConfig) -> int:
     """Global candidate width W for piggyback selection (≥ B)."""
     b = min(cfg.max_piggyback, cfg.rumor_slots)
@@ -423,14 +442,10 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     # 3. suspicion expiry via sentinels (deviation 2)
     filled = jnp.sum(st.sent_node >= 0, axis=-1).astype(jnp.int32)  # [R]
     if cfg.lifeguard and cfg.dynamic_suspicion:
-        base_to = jnp.float32(cfg.suspicion_periods)
-        max_to = jnp.float32(cfg.suspicion_max_periods)
-        c_tot = jnp.float32(cfg.k_indirect + 1)
-        frac = jnp.log(jnp.maximum(filled.astype(jnp.float32), 1.0)
-                       ) / jnp.log(c_tot + 1.0)
-        timeout = jnp.maximum(base_to,
-                              max_to - (max_to - base_to) * frac)
-        timeout = jnp.ceil(timeout).astype(jnp.int32)
+        # Lifeguard timeout as a trace-time table over the filled-sentinel
+        # count (≤ S+1 entries): exact integers with no on-device float
+        # math, so the scalar oracle reproduces it bitwise.
+        timeout = dynamic_timeout_table(cfg)[jnp.clip(filled, 0, s_cap)]
     else:
         timeout = jnp.full((r_cap,), cfg.suspicion_periods, jnp.int32)
     snode = st.sent_node

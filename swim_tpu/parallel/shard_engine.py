@@ -65,7 +65,9 @@ def _gather_flat(tree, axis_name=AX):
     """all_gather each array and flatten the shard axis into the rows."""
     def g(x):
         y = jax.lax.all_gather(x, axis_name)          # [D, local, ...]
-        return y.reshape((-1,) + y.shape[2:])
+        # explicit row count: -1 inference divides by the trailing sizes,
+        # which crashes (ZeroDivisionError) on zero-width carry arrays
+        return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
     return jax.tree.map(g, tree)
 
 
@@ -82,8 +84,13 @@ class _Msgs(NamedTuple):
     meta: jax.Array     # i32[M] response routing (target / pinger id)
 
 
+@functools.lru_cache(maxsize=32)
 def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
     """Compile-time builder: returns step(state, plan, rnd) under shard_map.
+
+    Memoized on (cfg, mesh, exchange_slack) — both are hashable — so sweep
+    loops (sim/experiments.py) reuse one jitted step per configuration
+    instead of retracing per sweep point.
 
     `exchange_slack` bounds response-wave compaction at slack×(expected
     per-shard load); None defaults to the mesh size D, which is lossless
@@ -296,7 +303,6 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
             return out, jnp.maximum(total - cap, 0)
 
         overflow = state.overflow
-        zc = jnp.zeros((n_loc, 0), jnp.float32)
 
         # ---- W1 PING i→T(i): all local probers --------------------------
         sel1, val1 = select_rows(knows)
@@ -355,11 +361,7 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
                         sel=sel4_all[row4],
                         val=val4_all[row4] & mine3[:, None],
                         forced=jnp.where(
-                            mine3, buddy_rows(knows, tgt4)[
-                                jnp.arange(tgt4.shape[0]) * 0
-                            ] if False else buddy_rows(
-                                knows[row4] if False else knows, tgt4),
-                            -1),
+                            mine3, buddy_rows(knows[row4], tgt4), -1),
                         carry=g3.carry[:, 1:], meta=g3.src)
         w4c, drop4 = compact_msgs(w4_full, mine3, rly_cap)
         overflow = overflow + jax.lax.psum(drop4, AX)
@@ -433,14 +435,8 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
         # expiry: refutation checked by whichever shard owns each sentinel
         filled = jnp.sum(state.sent_node >= 0, axis=-1).astype(jnp.int32)
         if cfg.lifeguard and cfg.dynamic_suspicion:
-            base_to = jnp.float32(cfg.suspicion_periods)
-            max_to = jnp.float32(cfg.suspicion_max_periods)
-            c_tot = jnp.float32(cfg.k_indirect + 1)
-            frac = jnp.log(jnp.maximum(filled.astype(jnp.float32), 1.0)
-                           ) / jnp.log(c_tot + 1.0)
-            timeout = jnp.ceil(jnp.maximum(
-                base_to, max_to - (max_to - base_to) * frac)
-            ).astype(jnp.int32)
+            timeout = rumor.dynamic_timeout_table(cfg)[
+                jnp.clip(filled, 0, s_cap)]
         else:
             timeout = jnp.full((r_cap,), cfg.suspicion_periods, jnp.int32)
         snode = state.sent_node
@@ -582,6 +578,24 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
         in_specs=(node_specs, plan_specs, rnd_specs),
         out_specs=node_specs, check_vma=False)
     return jax.jit(smapped)
+
+
+@functools.lru_cache(maxsize=32)
+def build_run(cfg: SwimConfig, mesh, periods: int,
+              exchange_slack: int | None = None):
+    """Compile-time builder: run(state, plan, root_key) scanning `periods`
+    protocol periods of the explicitly-sharded step under one jit."""
+    step_fn = build_step(cfg, mesh, exchange_slack)
+
+    def runner(state: RumorState, plan: FaultPlan, root_key):
+        def body(stt, _):
+            rnd = rumor.draw_period_rumor(root_key, stt.step, cfg)
+            return step_fn(stt, plan, rnd), None
+
+        out, _ = jax.lax.scan(body, state, None, length=periods)
+        return out
+
+    return jax.jit(runner)
 
 
 def place(cfg: SwimConfig, mesh, state: RumorState, plan: FaultPlan):

@@ -44,6 +44,14 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
                periods: int, engine: str):
     mesh = pmesh.make_mesh()
     n = cfg.n_nodes
+    if engine == "shard":
+        from swim_tpu.parallel import shard_engine
+
+        state, plan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
+                                         plan)
+        step_fn = shard_engine.build_step(cfg, mesh)
+        return runner.run_study_rumor(cfg, state, plan, key, periods,
+                                      step_fn)
     plan = pmesh.shard_state(plan, mesh, n=n)
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
@@ -67,7 +75,7 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
            "suspicion_periods": cfg.suspicion_periods}
     out.update(runner.detection_summary(res, plan, periods))
     out.update(metrics.series_digest(res.series))
-    if engine == "rumor":
+    if engine in ("rumor", "shard"):
         out["overflow"] = int(res.state.overflow)
     return out
 
@@ -106,7 +114,7 @@ def fp_sweep(n: int = 100_000, losses: tuple = (0.0, 0.1, 0.2, 0.3),
             "max_incarnation": int(np.asarray(
                 series.max_incarnation).max()),
         }
-        if engine == "rumor":
+        if engine in ("rumor", "shard"):
             pt["overflow"] = int(res.state.overflow)
         points.append(pt)
     return {"study": "fp_sweep", "n": n, "periods": periods,

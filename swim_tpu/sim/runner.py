@@ -154,9 +154,13 @@ def _rumor_subject_flags(cfg: SwimConfig, st, up: jax.Array):
     return not_alive, dead_seen, dead_all, counts
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5))
 def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
-                    root_key: jax.Array, periods: int) -> RumorStudyResult:
+                    root_key: jax.Array, periods: int,
+                    step_fn=None) -> RumorStudyResult:
+    """Rumor-engine study. `step_fn(state, plan, rnd)` overrides the step
+    (static arg) — used to run the explicitly-sharded engine
+    (swim_tpu/parallel/shard_engine.build_step) under the same metrics."""
     from swim_tpu.models import rumor as rumor_mod
 
     n = cfg.n_nodes
@@ -166,7 +170,10 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
     def body(carry, _):
         st, track = carry
         rnd = rumor_mod.draw_period_rumor(root_key, st.step, cfg)
-        st = rumor_mod.step(cfg, st, plan, rnd)
+        if step_fn is None:
+            st = rumor_mod.step(cfg, st, plan, rnd)
+        else:
+            st = step_fn(st, plan, rnd)
         t = st.step - 1
         crashed = t >= plan.crash_step
         up = ~crashed

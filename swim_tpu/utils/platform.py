@@ -1,0 +1,43 @@
+"""JAX platform forcing — the one copy of a subtle, order-sensitive dance.
+
+This sandbox pins ``JAX_PLATFORMS=axon`` (the real-TPU tunnel) via
+``sitecustomize``, and that backend has been observed to hang device
+queries for minutes when unhealthy (VERDICT r1). Environment variables
+cannot override the pin once Python is up; ``jax.config.update`` can —
+but only if it runs before the first backend initialization, and the
+virtual-device flag must land in ``XLA_FLAGS`` before that too.
+
+Every entry point that needs to survive a broken TPU tunnel (bench.py,
+``__graft_entry__.dryrun_multichip``, the CLI ``--platform`` flag, test
+conftest) routes through :func:`force_cpu`.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_virtual_devices(n_devices: int) -> None:
+    """Ask XLA's host platform for `n_devices` virtual CPU devices.
+
+    Appends ``--xla_force_host_platform_device_count`` unless some count is
+    already configured (first writer wins — changing it after a backend
+    exists has no effect anyway).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Force the CPU platform (optionally with a virtual multi-device mesh).
+
+    Must run before any jax device query. Safe to call repeatedly.
+    """
+    if n_devices is not None and n_devices > 1:
+        ensure_virtual_devices(n_devices)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

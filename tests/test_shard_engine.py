@@ -1,0 +1,143 @@
+"""Explicitly-sharded rumor engine vs the single-device engine.
+
+The shard_map engine (swim_tpu/parallel/shard_engine.py) restructures one
+protocol period into per-shard compute + compact all_gather exchanges. At
+`exchange_slack = D` (the default) the exchange is lossless, so the engine
+must be **bitwise identical** to `rumor.step` under the same
+RumorRandomness, period by period, through every phase: retirement,
+probing, all six message waves, suspicion expiry via sentinels,
+refutation, and originations.
+
+At small slack the exchange may drop messages under target skew; that must
+surface as counted overflow, never as a crash or silent divergence.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import rumor
+from swim_tpu.parallel import mesh as pmesh, shard_engine
+from swim_tpu.sim import faults
+
+
+def run_pair(cfg, plan, periods, key=None, exchange_slack=None):
+    """Step both engines on shared randomness; assert bitwise equality of
+    the FULL state after every period. Returns the final states."""
+    key = key if key is not None else jax.random.key(7)
+    mesh = pmesh.make_mesh(8)
+    sstep = shard_engine.build_step(cfg, mesh, exchange_slack)
+    sstate, splan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
+                                       plan)
+    rstate = rumor.init_state(cfg)
+    rstep = jax.jit(lambda s, r: rumor.step(cfg, s, plan, r))
+    for t in range(periods):
+        rnd = rumor.draw_period_rumor(key, t, cfg)
+        sstate = sstep(sstate, splan, rnd)
+        rstate = rstep(rstate, rnd)
+        if exchange_slack is None:  # lossless: bitwise equal
+            for name, a, b in zip(rumor.RumorState._fields, sstate, rstate):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"period {t}, field {name}")
+    return sstate, rstate
+
+
+class TestLosslessBitwise:
+    def test_crash_and_loss_full_lifecycle(self):
+        """Crash + 20% loss through suspicion expiry, confirm, death
+        dissemination and rumor retirement — every phase exercised."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=128)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [9], [1]), 0.2)
+        st, _ = run_pair(cfg, plan, 18)
+        # the run actually produced and confirmed a suspicion
+        from swim_tpu.ops import lattice
+
+        assert bool(np.asarray(lattice.is_dead(st.gone_key))[9]) or bool(
+            np.asarray(lattice.is_dead(st.rkey)
+                       & (np.asarray(st.subject) == 9)).any())
+
+    def test_lifeguard_buddy_dynamic_suspicion(self):
+        """Lifeguard on: LHA probe thinning, buddy forced rumors (the W1/W4
+        forced channel), dynamic suspicion timeouts."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=128, lifeguard=True,
+                         dynamic_suspicion=True, buddy=True)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 33], [2]), 0.15)
+        run_pair(cfg, plan, 16, key=jax.random.key(3))
+
+    def test_partition_round_robin(self):
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=128,
+                         target_selection="round_robin")
+        plan = faults.with_loss(faults.none(n), 0.1)
+        plan = faults.with_partition(plan, faults.halves(n), 2, 8)
+        run_pair(cfg, plan, 12, key=jax.random.key(11))
+
+
+class TestSmallSlack:
+    def test_overflow_counted_not_crashed(self):
+        """slack=1 caps each response exchange at n_loc slots; with a
+        round-robin-free uniform draw the ack waves overflow under skew.
+        The engine must count drops and keep running."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=128)
+        plan = faults.with_crashes(faults.none(n), [9], [1])
+        mesh = pmesh.make_mesh(8)
+        sstep = shard_engine.build_step(cfg, mesh, exchange_slack=1)
+        sstate, splan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
+                                           plan)
+        key = jax.random.key(0)
+        for t in range(10):
+            sstate = sstep(sstate, splan, rumor.draw_period_rumor(key, t,
+                                                                  cfg))
+        assert int(sstate.step) == 10
+        # the capped exchange really dropped messages — and counted them
+        assert int(sstate.overflow) > 0
+        for leaf in jax.tree.leaves(sstate):
+            assert not np.isnan(np.asarray(leaf, dtype=np.float64)).any()
+
+    def test_slack_d_equals_none(self):
+        """Explicit slack=D is the documented lossless setting."""
+        n = 32
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=64)
+        plan = faults.with_loss(faults.none(n), 0.25)
+        a, _ = run_pair(cfg, plan, 6, exchange_slack=None)
+        mesh = pmesh.make_mesh(8)
+        sstep = shard_engine.build_step(cfg, mesh, exchange_slack=8)
+        sstate, splan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
+                                           plan)
+        key = jax.random.key(7)
+        for t in range(6):
+            sstate = sstep(sstate, splan, rumor.draw_period_rumor(key, t,
+                                                                  cfg))
+        for name, x, y in zip(rumor.RumorState._fields, sstate, a):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=name)
+
+
+class TestBuildRun:
+    def test_scanned_run_matches_stepped(self):
+        n = 64
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=128)
+        plan = faults.with_crashes(faults.none(n), [4], [0])
+        key = jax.random.key(5)
+        mesh = pmesh.make_mesh(8)
+        run = shard_engine.build_run(cfg, mesh, 8)
+        sstate, splan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
+                                           plan)
+        scanned = run(sstate, splan, key)
+
+        rstate = rumor.init_state(cfg)
+        for t in range(8):
+            rstate = rumor.step(cfg, rstate, plan,
+                                rumor.draw_period_rumor(key, t, cfg))
+        for name, a, b in zip(rumor.RumorState._fields, scanned, rstate):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)

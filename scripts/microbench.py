@@ -1,0 +1,113 @@
+"""Microbenchmarks for the candidate ring-engine primitives on TPU.
+
+Measures the per-op cost of the memory patterns the fast engine would use,
+so the design is chosen from data, not guesses:
+
+  1. row-gather of packed window words by a permutation (wave delivery)
+  2. column take + column scatter of a few u32 words (window access)
+  3. elementwise .at[dst, sel].max boolean scatter (the CURRENT engine's
+     wave delivery — suspected dominant cost)
+  4. feistel permutation evaluation (compute-only target selection)
+  5. per-period uniform generation (loss draws)
+  6. full packed-knows popcount reduction (knower counts / retirement)
+  7. two-level per-subject view gather (opinion_of replacement)
+
+Usage: python scripts/microbench.py [N]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+RW = 64          # packed words per node (R = 2048 rumors)
+WW = 3           # window words
+K = 3
+REPS = 20
+
+
+def timeit(name, fn, *args):
+    fn_j = jax.jit(fn)
+    out = jax.block_until_ready(fn_j(*args))
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = jax.block_until_ready(fn_j(*args))
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:55s} {dt * 1e3:8.3f} ms")
+    return dt
+
+
+def main():
+    key = jax.random.key(0)
+    print(f"N={N}, RW={RW} words ({RW * 32} rumors), platform="
+          f"{jax.devices()[0].platform}")
+
+    knows = jax.random.randint(key, (N, RW), 0, 2**31).astype(jnp.uint32)
+    win = knows[:, :WW]
+    perm = jax.random.permutation(key, N).astype(jnp.int32)
+    dst = jax.random.randint(key, (N,), 0, N).astype(jnp.int32)
+    sel = jax.random.randint(key, (N, 6), 0, 64).astype(jnp.int32)
+    upd = jnp.ones((N, 6), jnp.bool_)
+    kbool = jnp.zeros((N, 64), jnp.bool_)
+    widx = jnp.asarray([17, 18, 19], jnp.int32)
+    subj_slots = jax.random.randint(key, (N, 4), 0, RW * 32).astype(jnp.int32)
+
+    # 1. wave delivery as row gather by permutation + OR
+    timeit("row-gather win[perm] | win  (u32[N,3])",
+           lambda w, p: w[p] | w, win, perm)
+    # 1b. row gather with RANDOM (non-perm) indices
+    timeit("row-gather win[dst] | win   (u32[N,3])",
+           lambda w, d: w[d] | w, win, dst)
+    # 2. column take + column scatter
+    timeit("col-take knows[:, widx]      (u32[N,3] of [N,64])",
+           lambda kn, w: jnp.take(kn, w, axis=1), knows, widx)
+    timeit("col-scatter knows.at[:, widx].set",
+           lambda kn, w, v: kn.at[:, w].set(v), knows, widx, win)
+    # 3. the current engine's elementwise boolean scatter
+    timeit("bool scatter .at[dst,sel].max  ([N,6] into [N,64])",
+           lambda kb, d, s, u: kb.at[d[:, None], s].max(u),
+           kbool, dst, sel, upd)
+    # 4. feistel eval
+    from swim_tpu.ops import sampling
+    ids = jnp.arange(N, dtype=jnp.uint32)
+    timeit("feistel perm eval            (u32[N])",
+           lambda i: sampling.feistel(i, N, jnp.uint32(123),
+                                      jnp.uint32(456)), ids)
+    # 5. uniforms
+    timeit("uniform [N, 14] f32",
+           lambda k: jax.random.uniform(k, (N, 14)), key)
+    timeit("random_bits [N, 4] u32",
+           lambda k: jax.random.bits(k, (N, 4), jnp.uint32), key)
+    # 6. popcount reduce
+    timeit("popcount-sum over knows      (u32[N,64] -> [64])",
+           lambda kn: jax.lax.population_count(kn).sum(axis=0), knows)
+    # per-rumor knower count (unpack reduce)
+    def knower_counts(kn):
+        bits = jnp.right_shift(kn[:, :, None],
+                               jnp.arange(32, dtype=jnp.uint32)) & 1
+        return bits.sum(axis=0).reshape(-1)
+    timeit("per-rumor knower counts      ([N,64]->[2048])",
+           knower_counts, knows)
+    # 7. two-level view gather: word = slot>>5, bit = slot&31
+    def view_gather(kn, ss):
+        w = ss >> 5
+        b = ss & 31
+        words = jnp.take_along_axis(kn, w, axis=1)
+        return (jnp.right_shift(words, b.astype(jnp.uint32)) & 1) > 0
+    timeit("view gather knows[i,slot[i,c]] ([N,4])",
+           view_gather, knows, subj_slots)
+    # 8. full-array elementwise pass for reference
+    timeit("elementwise pass knows|1     (u32[N,64])",
+           lambda kn: kn | jnp.uint32(1), knows)
+    timeit("elementwise pass win|1       (u32[N,3])",
+           lambda w: w | jnp.uint32(1), win)
+
+
+if __name__ == "__main__":
+    main()

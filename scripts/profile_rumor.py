@@ -1,0 +1,45 @@
+"""Profile one rumor-engine period on the current backend.
+
+Usage: python scripts/profile_rumor.py [N] [R] [--trace DIR]
+Prints per-period wall time; with --trace, writes a jax.profiler trace.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 262_144
+r = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+trace_dir = None
+if "--trace" in sys.argv:
+    trace_dir = sys.argv[sys.argv.index("--trace") + 1]
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import rumor
+from swim_tpu.sim import faults
+
+cfg = SwimConfig(n_nodes=n, rumor_capacity=r)
+plan = faults.with_random_crashes(
+    faults.none(n), jax.random.key(1), 0.001, 0, 10)
+state = rumor.init_state(cfg)
+key = jax.random.key(0)
+
+step = jax.jit(lambda st: rumor.run(cfg, st, plan, key, 5))
+t0 = time.perf_counter()
+out = jax.block_until_ready(step(state))
+print(f"compile+first: {time.perf_counter() - t0:.2f}s")
+t0 = time.perf_counter()
+out = jax.block_until_ready(step(state))
+dt = time.perf_counter() - t0
+print(f"5 periods: {dt:.3f}s -> {dt / 5 * 1e3:.1f} ms/period, "
+      f"{5 / dt:.1f} periods/sec @ N={n} R={r}")
+
+if trace_dir:
+    with jax.profiler.trace(trace_dir):
+        jax.block_until_ready(step(state))
+    print("trace written to", trace_dir)

@@ -39,19 +39,20 @@ from swim_tpu.config import SwimConfig
 from swim_tpu.models.rumor import (RESAMPLE_ATTEMPTS, RumorRandomness,
                                    _budget, _pig_window, dynamic_timeout_py)
 from swim_tpu.sim.faults import FaultPlan
-from swim_tpu.types import INC_MAX, Status, key_incarnation, key_status
+from swim_tpu.types import (Status, key_incarnation, key_status,
+                            opinion_key)
 
 
 def _alive_key(inc: int) -> int:
-    return min(inc, INC_MAX) << 1
+    return opinion_key(Status.ALIVE, inc)
 
 
 def _suspect_key(inc: int) -> int:
-    return (min(inc, INC_MAX) << 1) | 1
+    return opinion_key(Status.SUSPECT, inc)
 
 
 def _dead_key(inc: int) -> int:
-    return (1 << 31) | (min(inc, INC_MAX) << 1)
+    return opinion_key(Status.DEAD, inc)
 
 
 def _is_suspect(key: int) -> bool:

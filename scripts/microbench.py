@@ -39,7 +39,7 @@ def timeit(name, fn, *args):
     for _ in range(REPS):
         out = jax.block_until_ready(fn_j(*args))
     dt = (time.perf_counter() - t0) / REPS
-    print(f"{name:55s} {dt * 1e3:8.3f} ms")
+    print(f"{name:55s} {dt * 1e3:8.3f} ms", flush=True)
     return dt
 
 
@@ -69,10 +69,10 @@ def main():
            lambda kn, w: jnp.take(kn, w, axis=1), knows, widx)
     timeit("col-scatter knows.at[:, widx].set",
            lambda kn, w, v: kn.at[:, w].set(v), knows, widx, win)
-    # 3. the current engine's elementwise boolean scatter
-    timeit("bool scatter .at[dst,sel].max  ([N,6] into [N,64])",
-           lambda kb, d, s, u: kb.at[d[:, None], s].max(u),
-           kbool, dst, sel, upd)
+    timeit("col-dynslice + dynupdate     (u32[N,3] @ word 17)",
+           lambda kn, v: jax.lax.dynamic_update_slice(
+               kn, v | jax.lax.dynamic_slice(kn, (0, 17), (N, 3)),
+               (0, 17)), knows, win)
     # 4. feistel eval
     from swim_tpu.ops import sampling
     ids = jnp.arange(N, dtype=jnp.uint32)
@@ -107,6 +107,10 @@ def main():
            lambda kn: kn | jnp.uint32(1), knows)
     timeit("elementwise pass win|1       (u32[N,3])",
            lambda w: w | jnp.uint32(1), win)
+    # LAST (suspected pathological): the current engine's delivery scatter
+    timeit("bool scatter .at[dst,sel].max  ([N,6] into [N,64])",
+           lambda kb, d, s, u: kb.at[d[:, None], s].max(u),
+           kbool, dst, sel, upd)
 
 
 if __name__ == "__main__":

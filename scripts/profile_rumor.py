@@ -13,11 +13,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-n = int(sys.argv[1]) if len(sys.argv) > 1 else 262_144
-r = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+args = sys.argv[1:]
 trace_dir = None
-if "--trace" in sys.argv:
-    trace_dir = sys.argv[sys.argv.index("--trace") + 1]
+if "--trace" in args:
+    i = args.index("--trace")
+    if i + 1 >= len(args):
+        sys.exit("--trace needs a directory argument")
+    trace_dir = args[i + 1]
+    del args[i:i + 2]
+n = int(args[0]) if len(args) > 0 else 262_144
+r = int(args[1]) if len(args) > 1 else 256
 
 from swim_tpu import SwimConfig
 from swim_tpu.models import rumor

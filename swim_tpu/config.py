@@ -64,6 +64,10 @@ class SwimConfig:
     # --- engine capacity knobs (rumor engine only) ---
     rumor_capacity: int = 0      # 0 → sized automatically from n_nodes
     sentinels: int = 4           # independent suspectors tracked per rumor
+    # --- ring engine geometry (swim_tpu/models/ring.py) ---
+    ring_orig_words: int = 2     # OW: 32-slot words originated per period
+    ring_window_periods: int = 6  # window = OW * this many words
+    ring_view_c: int = 3         # per-subject top-C view index depth
 
     def __post_init__(self):
         if self.n_nodes < 2:

@@ -112,26 +112,31 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
                                       state.deadline, state.lha)
     ids = jnp.arange(n, dtype=jnp.int32)
     crashed = t >= plan.crash_step                     # bool[N]
-    up = ~crashed
+    joined = t >= plan.join_step
+    # active membership: joined and not crashed — not-yet-joined nodes
+    # neither act nor receive and are in nobody's membership list yet
+    up = ~crashed & joined
     part_on = ((t >= plan.partition_start) & (t < plan.partition_end))
 
     def delivered(src, dst, u):
         """Fault mask for a batch of directed messages (docs/PROTOCOL.md §3)."""
         cut = part_on & (plan.partition_id[src] != plan.partition_id[dst])
-        return (~crashed[src] & ~crashed[dst] & ~cut
+        return (up[src] & up[dst] & ~cut
                 & (u >= plan.loss.astype(jnp.float32)))
 
     # ---- Phase A: all random choices --------------------------------------
     not_dead = ~lattice.is_dead(key)
-    cand = not_dead & (ids[None, :] != ids[:, None])   # bool[N, N]
+    cand = (not_dead & (ids[None, :] != ids[:, None])
+            & joined[None, :])                         # bool[N, N]
     if cfg.target_selection == "round_robin":
         # SWIM §4.3 randomized round-robin: each node walks its own
         # per-epoch Feistel shuffle of the id space; believed-dead targets
-        # are probed and fail fast (docs/PROTOCOL.md §4)
+        # are probed and fail fast (docs/PROTOCOL.md §4). A not-yet-joined
+        # target means an idle period (no probe: not a member yet).
         epoch = jnp.broadcast_to(t // jnp.int32(n - 1), (n,))
         pos = jnp.broadcast_to(t % jnp.int32(n - 1), (n,))
         target = sampling.round_robin_target(ids, epoch, pos, n)
-        prober = up
+        prober = up & joined[target]
     else:
         target, has_cand = _masked_pick(cand, rnd.target_u)
         prober = up & has_cand                         # i sends a W1 ping
@@ -259,12 +264,12 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     retransmit = jnp.where(expire, 0, retransmit)
     deadline = jnp.where(expire, NO_DEADLINE, deadline)
 
-    # crashed nodes are frozen: restore their rows wholesale
-    frozen = crashed[:, None]
+    # inactive (crashed or not-yet-joined) nodes are frozen: restore rows
+    frozen = (~up)[:, None]
     key = jnp.where(frozen, state.key, key)
     retransmit = jnp.where(frozen, state.retransmit, retransmit)
     deadline = jnp.where(frozen, state.deadline, deadline)
-    lha = jnp.where(crashed, state.lha, lha)
+    lha = jnp.where(~up, state.lha, lha)
 
     return DenseState(key, retransmit, deadline, lha, t + 1)
 

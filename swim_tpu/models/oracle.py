@@ -70,8 +70,14 @@ class Oracle:
     def crashed(self, i: int, t: int) -> bool:
         return t >= int(self.plan.crash_step[i])
 
+    def joined(self, i: int, t: int) -> bool:
+        return t >= int(self.plan.join_step[i])
+
+    def active(self, i: int, t: int) -> bool:
+        return self.joined(i, t) and not self.crashed(i, t)
+
     def delivered(self, src: int, dst: int, t: int, u_loss: float) -> bool:
-        if self.crashed(src, t) or self.crashed(dst, t):
+        if not (self.active(src, t) and self.active(dst, t)):
             return False
         p = self.plan
         if (int(p.partition_start) <= t < int(p.partition_end)
@@ -128,7 +134,7 @@ class Oracle:
         st, cfg = self.state, self.cfg
         n, k, t = cfg.n_nodes, cfg.k_indirect, st.step
         rnd = _prng.to_numpy(rnd)
-        up = [i for i in range(n) if not self.crashed(i, t)]
+        up = [i for i in range(n) if self.active(i, t)]
 
         # ---- Phase A: all random choices (docs/PROTOCOL.md §4) ----
         from swim_tpu.ops.sampling import py_round_robin_target
@@ -138,12 +144,17 @@ class Oracle:
         target = {}
         proxies = {}
         for i in up:
+            # not-yet-joined nodes are in nobody's membership list
             cands = [j for j in range(n)
-                     if j != i and key_status(int(st.key[i, j])) != Status.DEAD]
+                     if j != i and key_status(int(st.key[i, j])) != Status.DEAD
+                     and self.joined(j, t)]
             if rr:
                 # §4.3 round-robin walks the node's per-epoch Feistel
-                # shuffle; believed-dead targets probed, fail fast
+                # shuffle; believed-dead targets probed, fail fast; a
+                # not-yet-joined target means an idle period
                 ti = py_round_robin_target(i, epoch, pos, n)
+                if not self.joined(ti, t):
+                    continue
             else:
                 if not cands:
                     continue

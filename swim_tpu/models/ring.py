@@ -1,0 +1,704 @@
+"""Ring engine — the TPU-throughput SWIM simulation (scatter-free).
+
+Why a third engine
+------------------
+The dense engine is exact but O(N²); the rumor engine is O(R·N) but its
+message waves deliver with elementwise scatters over random destination
+indices, which serialize on TPU (measured round 2: 1.56 s/period at
+N=1M — scatter dispatch, not HBM bandwidth, dominates).  This engine is
+designed backwards from the TPU memory system so one protocol period is a
+handful of fused streaming passes over ~50 MB of hot state at N=1M:
+
+  * **All-roll message waves.**  Probe targets follow the *rotor*
+    round-robin variant of SWIM §4.3: one shared pseudo-random offset
+    `s_t` per period, target(i) = (i + s_t) mod N, with s_t walking a
+    keyed Feistel shuffle of [1, N) per epoch, so every node probes
+    every other exactly once per epoch of N−1 periods (§4.3's
+    worst-case-detection bound, strengthened: every node is also probed
+    exactly once per period).  The k proxies use k more shared offsets.
+    Every wave's delivery is then `jnp.roll` by a traced scalar — no
+    gather, no scatter — and on a node-sharded mesh a roll lowers to
+    neighbor-chunk ICI transfers, the TPU-native analog of the
+    reference's socket fan-out (SURVEY.md §5 "Distributed comm
+    backend").
+  * **Bit-packed heard-sets.**  Which-node-has-heard-which-rumor lives
+    in u32 words (32 rumors/word): 8× less HBM traffic than the rumor
+    engine's bool[N, R], and the first-B piggyback selection runs as a
+    fused lowest-set-bit loop directly on the packed words (no top_k).
+  * **Ring table with word recycling.**  Rumors are allocated into OW
+    fresh 32-slot words per period; only the youngest WW words
+    (`win u32[N, WW]`, a static slice) are transmissible.  When a word
+    leaves the window, lanes whose rumor is still *spreading* (not yet
+    heard by every live node, spread budget left) are carried — bits,
+    metadata, suspicion timers — into the SAME lane of the
+    corresponding fresh word (`fresh[w] = outgoing[w] & carry_mask[w]`,
+    one fused op per word); finished lanes retire (tombstoning dead
+    rumors) and become free lanes for new originations.  Gossip thus
+    proceeds in window-length bursts for as long as SWIM's retransmit
+    budget would keep a rumor alive — fixing the rumor engine's global
+    age window, which stalled death dissemination at scale (measured:
+    7/8 deaths at N=4096 never completed) — while retirement costs one
+    [N, OW]-word pass per period instead of an O(R·N) scan.
+  * **Per-subject top-C index.**  View queries (probe verdicts,
+    refutation, buddy, sentinel refutation) never touch [N, R] masks:
+    a tiny [R]-table pass rebuilds top-C (key, slot) per subject each
+    period, and each query is C two-level word gathers, O(N·C).
+
+Protocol semantics are the rumor engine's (docs/PROTOCOL.md §3–§7 and
+its documented deviations) with these additional documented deviations:
+
+  R1. **Rotor probing.**  Shared-offset round-robin instead of per-node
+      shuffled lists: the §4.3 bounded-detection regime, not uniform
+      sampling — the e/(e−1) geometric law of the uniform mode does not
+      apply (a crash is detected in ≤ ~2 periods).  Proxy offsets may
+      coincide with each other / the target / self with probability
+      O(k/N); such a proxy slot is wasted (exact SWIM samples proxies
+      without replacement).
+  R2. **Burst transmissibility.**  A rumor gossips while its word is in
+      the window (WW/OW periods per burst), recycling while it spreads,
+      up to `2 * gossip_window` periods total; eviction of a
+      still-pending suspicion or a still-spreading rumor at budget end
+      is counted in `overflow`.
+  R3. **Top-C subject views.**  A viewer's opinion joins only the C
+      highest-keyed live rumors per subject; more than C concurrent
+      distinct assertions about one subject increments
+      `index_overflow`.  The join is a lower bound of the true view, so
+      degradation is toward slower detection, never wrong state.
+  R4. **Recycling-first allocation.**  Carried lanes always win over
+      new originations; a period whose new originations exceed the free
+      lanes drops the excess (priority confirm > refute > suspect) into
+      `overflow` — a dropped suspicion is re-detected by the next
+      failed probe, so overload degrades into latency, never wrong
+      state (same philosophy as the rumor engine's deviation 4).
+
+Join/churn: nodes with `FaultPlan.join_step > 0` are inert (no probing,
+no receiving, excluded from dissemination totals) until their join
+period — crash, join, and rejoin-under-a-fresh-id schedules compose.
+
+Reference parity note: jpfuentes2/swim (Haskell; tree unavailable at
+survey time, SURVEY.md §0) has no simulator — this engine is the
+TPU-native scaling capability the north star adds; its per-node protocol
+semantics follow docs/PROTOCOL.md like the other engines, validated
+bitwise against the scalar twin in swim_tpu/models/ring_oracle.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.ops import lattice, sampling
+from swim_tpu.sim.faults import FaultPlan
+
+WORD = 32
+
+
+class RingGeometry(NamedTuple):
+    """Static geometry derived from SwimConfig (plain Python ints)."""
+
+    ow: int       # words originated per period (lane budget OB = 32*ow)
+    ww: int       # window words (transmissible candidates = 32*ww)
+    rw: int       # cold ring words (total slots R = 32*rw)
+    c: int        # per-subject view index depth
+    spread: int   # total spread budget in periods (recycle cutoff)
+    life: int     # ring turnover in periods (rw = ow * life)
+
+
+def geometry(cfg: SwimConfig) -> RingGeometry:
+    ow = cfg.ring_orig_words
+    wp = cfg.ring_window_periods
+    spread = 2 * cfg.gossip_window
+    life = max(cfg.suspicion_max_periods + 4, spread + 2, wp + 2)
+    return RingGeometry(ow=ow, ww=ow * wp, rw=ow * life, c=cfg.ring_view_c,
+                        spread=spread, life=life)
+
+
+class RingState(NamedTuple):
+    """Node-axis tensors shard over the mesh; table tensors replicate."""
+
+    # --- per node (leading axis N, sharded) ---
+    win: jax.Array       # u32[N, WW]  heard-bits, youngest WW words
+    cold: jax.Array      # u32[N, RW]  heard-bits, cold ring (by ring word)
+    inc_self: jax.Array  # u32[N]
+    lha: jax.Array       # i32[N]
+    gone_key: jax.Array  # u32[N]   DEAD tombstone floor per subject
+    # --- rumor table (axis R = 32*RW ring slots, replicated) ---
+    subject: jax.Array    # i32[R]   -1 = free
+    rkey: jax.Array       # u32[R]
+    birth0: jax.Array     # i32[R]   first-generation birth (spread budget)
+    sent_node: jax.Array  # i32[R, S]
+    sent_time: jax.Array  # i32[R, S]
+    confirmed: jax.Array  # bool[R]
+    # --- scalars ---
+    overflow: jax.Array        # i32  dropped originations / evictions
+    index_overflow: jax.Array  # i32  deviation-R3 occurrences
+    step: jax.Array            # i32
+
+
+def init_state(cfg: SwimConfig) -> RingState:
+    g = geometry(cfg)
+    n, r, s = cfg.n_nodes, g.rw * WORD, cfg.sentinels
+    return RingState(
+        win=jnp.zeros((n, g.ww), jnp.uint32),
+        cold=jnp.zeros((n, g.rw), jnp.uint32),
+        inc_self=jnp.zeros((n,), jnp.uint32),
+        lha=jnp.zeros((n,), jnp.int32),
+        gone_key=jnp.zeros((n,), jnp.uint32),
+        subject=jnp.full((r,), -1, jnp.int32),
+        rkey=jnp.zeros((r,), jnp.uint32),
+        birth0=jnp.zeros((r,), jnp.int32),
+        sent_node=jnp.full((r, s), -1, jnp.int32),
+        sent_time=jnp.zeros((r, s), jnp.int32),
+        confirmed=jnp.zeros((r,), jnp.bool_),
+        overflow=jnp.int32(0),
+        index_overflow=jnp.int32(0),
+        step=jnp.int32(0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Slot arithmetic.
+#
+# Global word G is the G-th 32-slot word ever allocated; period t allocates
+# (into the window's youngest columns) global words [t*OW, (t+1)*OW).
+# ON ENTRY to step(t), win column w holds global word  t*OW − WW + w  (the
+# window as period t−1 left it).  The Phase-0 shift drops columns [0, OW)
+# and appends the OW fresh (zero) columns, after which win column w holds
+# global word  (t+1)*OW − WW + w.  A global word lives in cold column
+# (G mod RW) from the moment it leaves the window until the ring reuses
+# that column.  Ring slot of (G, bit b) = (G mod RW)*32 + b, and the ring
+# slot axis has R = 32*RW entries.
+#
+# Negative global words (early periods) denote never-allocated space; mod
+# arithmetic maps them onto empty columns, which is harmless.
+# ---------------------------------------------------------------------------
+
+
+class RingRandomness(NamedTuple):
+    s_off: jax.Array    # i32 scalar: probe offset in [1, N)
+    q_off: jax.Array    # i32[k]:  proxy offsets in [1, N)
+    loss_w1: jax.Array  # f32[N]
+    loss_w2: jax.Array  # f32[N]
+    loss_w3: jax.Array  # f32[N, k]
+    loss_w4: jax.Array  # f32[N, k]
+    loss_w5: jax.Array  # f32[N, k]
+    loss_w6: jax.Array  # f32[N, k]
+    lha_u: jax.Array    # f32[N]  Lifeguard probe-thinning uniform
+
+
+def draw_period_ring(key: jax.Array, step, cfg: SwimConfig) -> RingRandomness:
+    n, k = cfg.n_nodes, cfg.k_indirect
+    t = jnp.asarray(step, jnp.int32)
+    # rotor offset: position (t mod N−1) of an epoch-keyed shuffle of [0,N−1)
+    epoch = (t // jnp.int32(n - 1)).astype(jnp.uint32)
+    pos = jnp.mod(t, jnp.int32(n - 1)).astype(jnp.uint32)
+    ka = sampling._mix32(epoch * jnp.uint32(0x9E3779B9) + jnp.uint32(0xABCD))
+    kb = sampling._mix32(epoch ^ jnp.uint32(0x7F4A7C15))
+    s_off = sampling.feistel(pos, n - 1, ka, kb) + 1            # [1, N)
+    # proxy offsets: k positions of a per-period shuffle (mutually
+    # distinct; may equal s_off or wrap onto self/target with prob
+    # O(k/N) — deviation R1)
+    tk = jnp.asarray(step, jnp.uint32)
+    pka = sampling._mix32(tk * jnp.uint32(0x85EBCA6B) + jnp.uint32(0x51ED))
+    pkb = sampling._mix32(tk ^ jnp.uint32(0xC2B2AE35))
+    q_off = sampling.feistel(jnp.arange(k, dtype=jnp.uint32), n - 1,
+                             pka, pkb) + 1
+    kk = jax.random.fold_in(key, step)
+    ks = jax.random.split(kk, 7)
+    return RingRandomness(
+        s_off=s_off.astype(jnp.int32),
+        q_off=q_off.astype(jnp.int32),
+        loss_w1=jax.random.uniform(ks[0], (n,)),
+        loss_w2=jax.random.uniform(ks[1], (n,)),
+        loss_w3=jax.random.uniform(ks[2], (n, k)),
+        loss_w4=jax.random.uniform(ks[3], (n, k)),
+        loss_w5=jax.random.uniform(ks[4], (n, k)),
+        loss_w6=jax.random.uniform(ks[5], (n, k)),
+        lha_u=jax.random.uniform(ks[6], (n,)),
+    )
+
+
+def _select_first_b(win_masked, b: int):
+    """u32[N, WW]: mask of the first `b` set bits of each row's window,
+    newest word first, LSB-first within a word — a fused branch-free
+    lowest-set-bit extract loop (no top_k, no unpacking)."""
+    ww = win_masked.shape[-1]
+    taken = [None] * ww
+    budget = jnp.full(win_masked.shape[:1], b, jnp.int32)
+    for w in range(ww - 1, -1, -1):         # newest word first
+        m = win_masked[:, w]
+        acc = jnp.zeros_like(m)
+        for _ in range(min(b, WORD)):
+            low = m & (jnp.uint32(0) - m)   # lowest set bit (0 if none)
+            bitm = jnp.where(budget > 0, low, jnp.uint32(0))
+            acc = acc | bitm
+            m = m ^ bitm
+            budget = budget - (bitm != 0).astype(jnp.int32)
+        taken[w] = acc
+    return jnp.stack(taken, axis=-1)
+
+
+def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
+         rnd: RingRandomness) -> RingState:
+    """One protocol period for all N nodes (pure; jit with cfg static)."""
+    g = geometry(cfg)
+    n, k = cfg.n_nodes, cfg.k_indirect
+    r_tot, s_cap = g.rw * WORD, cfg.sentinels
+    ob = g.ow * WORD
+    t = state.step
+    ids = jnp.arange(n, dtype=jnp.int32)
+    rr = jnp.arange(r_tot, dtype=jnp.int32)
+    lanes = jnp.arange(ob, dtype=jnp.int32)
+    crashed = t >= plan.crash_step
+    joined = t >= plan.join_step
+    active = ~crashed & joined
+    part_on = (t >= plan.partition_start) & (t < plan.partition_end)
+    live_total = jnp.sum(active).astype(jnp.int32)
+
+    subject, rkey, birth0 = state.subject, state.rkey, state.birth0
+    snode, stime = state.sent_node, state.sent_time
+    confirmed = state.confirmed
+    gone_key = state.gone_key
+    overflow = state.overflow
+    cold = state.cold
+
+    entry_gw0 = t * g.ow - g.ww        # entry win col 0's global word
+    fresh_gw0 = t * g.ow               # this period's first fresh word
+
+    # ---- Phase 0a: judge the outgoing words (entry win cols [0, OW)) ------
+    out_cols = state.win[:, :g.ow]                             # u32[N, OW]
+    out_knowers = jnp.stack(
+        [jnp.sum(jnp.where(
+            active, (out_cols[:, la // WORD] >> jnp.uint32(la % WORD))
+            & jnp.uint32(1), jnp.uint32(0))).astype(jnp.int32)
+         for la in range(ob)])                                 # i32[OB]
+    out_rcol = jnp.mod(entry_gw0 + lanes // WORD, g.rw)
+    out_slots = out_rcol * WORD + lanes % WORD                 # i32[OB]
+    out_sub = subject[out_slots]
+    out_key = rkey[out_slots]
+    out_used = out_sub >= 0
+    out_dissem = out_knowers >= live_total
+    in_budget = (t - birth0[out_slots]) < g.spread
+    # three classes: carry (still spreading -> recycle into the same lane
+    # of the fresh word), keep (pending suspicion: timer still running —
+    # stays at its now-cold slot, stops transmitting), retire (done).
+    # A suspicion outranked by any live same-subject rumor or by the
+    # dissemination floor is refuted — it retires instead of being kept.
+    glob_refuted = (jnp.any(
+        (subject[None, :] == out_sub[:, None]) & (subject >= 0)[None, :]
+        & (rkey[None, :] > out_key[:, None]), axis=-1)
+        | (gone_key[jnp.maximum(out_sub, 0)] > out_key))
+    pending = (out_used & lattice.is_suspect(out_key)
+               & ~confirmed[out_slots] & ~glob_refuted)
+    carry = out_used & ~out_dissem & in_budget
+    keep = out_used & ~carry & pending
+    retire = out_used & ~carry & ~keep
+    out_dead = out_used & lattice.is_dead(out_key)
+    # ANY fully-disseminated retiring key floors the subject's views and
+    # permanently refutes lower-keyed suspicions (`gone_key` is the
+    # dissemination floor; its DEAD restriction is the death tombstone) —
+    # without this, a refutation that disseminates and retires would
+    # become invisible to later sentinel-expiry checks.
+    tomb = retire & out_dissem
+    gone_key = gone_key.at[jnp.where(tomb, out_sub, n)].max(
+        out_key, mode="drop")
+    # a death evicted before full dissemination is a lost certificate
+    overflow = overflow + jnp.sum(retire & out_dead & ~out_dissem
+                                  ).astype(jnp.int32)
+
+    # ---- Phase 0b: invalidate the previous generation of the fresh cols ---
+    fresh_rcol = jnp.mod(fresh_gw0 + lanes // WORD, g.rw)
+    fresh_slots = fresh_rcol * WORD + lanes % WORD             # i32[OB]
+    inv_sub = subject[fresh_slots]
+    inv_used = inv_sub >= 0
+    inv_key = rkey[fresh_slots]
+    inv_knowers = jnp.stack(
+        [jnp.sum(jnp.where(
+            active,
+            (jax.lax.dynamic_index_in_dim(
+                cold, jnp.mod(fresh_gw0 + la // WORD, g.rw), axis=1,
+                keepdims=False) >> jnp.uint32(la % WORD)) & jnp.uint32(1),
+            jnp.uint32(0))).astype(jnp.int32)
+         for la in range(ob)])
+    inv_tomb = inv_used & (inv_knowers >= live_total)
+    gone_key = gone_key.at[jnp.where(inv_tomb, inv_sub, n)].max(
+        inv_key, mode="drop")
+    # kept (pending-suspicion) slots reaped here had life >= timeout + 4
+    # periods — their timers have provably resolved, so reaping is silent
+    subject = subject.at[jnp.where(inv_used, fresh_slots, r_tot)].set(
+        -1, mode="drop")
+
+    # ---- Phase 0c: move carried lanes old slot -> same lane of fresh word -
+    mv_src = jnp.where(carry, out_slots, r_tot)    # gather rows (drop-safe)
+    mv_dst = jnp.where(carry, fresh_slots, r_tot)
+    subject = subject.at[mv_dst].set(
+        jnp.where(carry, out_sub, -1), mode="drop")
+    rkey = rkey.at[mv_dst].set(out_key, mode="drop")
+    birth0 = birth0.at[mv_dst].set(birth0[jnp.minimum(mv_src, r_tot - 1)],
+                                   mode="drop")
+    confirmed = confirmed.at[mv_dst].set(
+        confirmed[jnp.minimum(mv_src, r_tot - 1)], mode="drop")
+    snode = snode.at[mv_dst].set(snode[jnp.minimum(mv_src, r_tot - 1)],
+                                 mode="drop")
+    stime = stime.at[mv_dst].set(stime[jnp.minimum(mv_src, r_tot - 1)],
+                                 mode="drop")
+    # carried and retired outgoing slots free now; kept slots stay used.
+    # (A dst can never equal a src: out and fresh ring columns are
+    # distinct because 0 < WW < RW.)
+    subject = subject.at[jnp.where(carry | retire, out_slots, r_tot)].set(
+        -1, mode="drop")
+
+    carry_mask = jnp.stack(
+        [jnp.sum(jnp.where(carry[w * WORD:(w + 1) * WORD],
+                           jnp.uint32(1) << jnp.arange(
+                               WORD, dtype=jnp.uint32), jnp.uint32(0)))
+         for w in range(g.ow)]).astype(jnp.uint32)             # u32[OW]
+
+    # ---- Phase 0d: flush out cols to cold, shift window, carry bits -------
+    for w in range(g.ow):
+        cold = jax.lax.dynamic_update_index_in_dim(
+            cold, state.win[:, w], jnp.mod(entry_gw0 + w, g.rw), axis=1)
+    fresh_cols = out_cols & carry_mask[None, :]                # u32[N, OW]
+    win = jnp.concatenate([state.win[:, g.ow:], fresh_cols], axis=1)
+    first_gw = entry_gw0 + g.ow        # win col 0's global word, post-shift
+    win_ring0 = jnp.mod(first_gw, g.rw)
+
+    # ---- per-subject top-C index (R3) -------------------------------------
+    used = subject >= 0
+    sub_or_n = jnp.where(used, subject, n)
+    top_key, top_slot = [], []
+    remaining = used
+    for _ in range(g.c):
+        bk = jnp.zeros((n,), jnp.uint32).at[
+            jnp.where(remaining, subject, n)].max(rkey, mode="drop")
+        bk_at_r = bk[jnp.maximum(subject, 0)]
+        hit = remaining & (rkey == bk_at_r) & (bk_at_r > 0)
+        bs = jnp.full((n,), -1, jnp.int32).at[
+            jnp.where(hit, subject, n)].max(rr, mode="drop")
+        top_key.append(bk)
+        top_slot.append(bs)
+        remaining = remaining & ~(rr == bs[jnp.maximum(subject, 0)])
+    n_per_subj = jnp.zeros((n,), jnp.int32).at[sub_or_n].add(1, mode="drop")
+    index_overflow = state.index_overflow + jnp.sum(
+        n_per_subj > g.c).astype(jnp.int32)
+    sus_hit = used & lattice.is_suspect(rkey)
+    sus_bk = jnp.zeros((n,), jnp.uint32).at[
+        jnp.where(sus_hit, subject, n)].max(rkey, mode="drop")
+    sus_slot = jnp.full((n,), -1, jnp.int32).at[
+        jnp.where(sus_hit & (rkey == sus_bk[jnp.maximum(subject, 0)]),
+                  subject, n)].max(rr, mode="drop")
+
+    def slot_pos(slot):
+        """(in_win, win_col, ring_word, bit) for ring slot array `slot`."""
+        sl = jnp.maximum(slot, 0)
+        word_r = sl // WORD
+        bit = (sl % WORD).astype(jnp.uint32)
+        off = jnp.mod(word_r - win_ring0, g.rw)
+        return ((slot >= 0) & (off < g.ww),
+                jnp.minimum(off, g.ww - 1), word_r, bit)
+
+    def knows_bit(rows, slot):
+        """bool[shape]: does node rows[...] know ring slot slot[...]?"""
+        ok, wcol, word_r, bit = slot_pos(slot)
+        word = jnp.where(ok, win[rows, wcol], cold[rows, word_r])
+        return (slot >= 0) & (((word >> bit) & 1) > 0)
+
+    def view_of(rows, subj):
+        """u32[shape]: rows[...]'s opinion key of subj[...] (top-C join)."""
+        best = jnp.maximum(lattice.alive_key(jnp.uint32(0)), gone_key[subj])
+        for lvl in range(g.c):
+            slot = top_slot[lvl][subj]
+            kn = knows_bit(rows, slot)
+            best = jnp.maximum(
+                best, jnp.where(kn, top_key[lvl][subj], jnp.uint32(0)))
+        return best
+
+    # ---- Phase A: rotor offsets -------------------------------------------
+    s_off = rnd.s_off
+    target = jnp.mod(ids + s_off, n)
+    # a not-yet-joined target is in nobody's membership list: idle period
+    prober = active & joined[target]
+    pid = plan.partition_id
+    loss_f = plan.loss.astype(jnp.float32)
+
+    def roll_from(x, d):
+        """Value of x at node (i + d) mod n, for each i (d traced)."""
+        return jnp.roll(x, -d, axis=0)
+
+    # ---- Phase B: six waves, all rolls ------------------------------------
+    b_pig = min(cfg.max_piggyback, g.ww * WORD)
+    win_slots_lin = jnp.mod(win_ring0 * WORD
+                            + jnp.arange(g.ww * WORD, dtype=jnp.int32),
+                            r_tot)
+    elig = used[win_slots_lin].reshape(g.ww, WORD)
+    elig_mask = jnp.sum(jnp.where(
+        elig, jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)[None, :],
+        jnp.uint32(0)), axis=1)                                # u32[WW]
+
+    def buddy_bits(subj):
+        """u32[N, WW]: forced window bit of the suspect witness about
+        subj[i], when sender i knows it and it lies in the window."""
+        if not (cfg.lifeguard and cfg.buddy):
+            return jnp.zeros((n, g.ww), jnp.uint32)
+        slot = sus_slot[subj]
+        kn = knows_bit(ids, slot)
+        in_win, wcol, _, bit = slot_pos(slot)
+        usebit = kn & in_win
+        onehot_w = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]
+                    == wcol[:, None])
+        return jnp.where(usebit[:, None] & onehot_w,
+                         (jnp.uint32(1) << bit)[:, None], jnp.uint32(0))
+
+    def sel_now(forced):
+        return _select_first_b(win & elig_mask[None, :], b_pig) | forced
+
+    def wave_ok(send_flag_at_sender, d, u):
+        """bool[N] per receiver i: the message from node (i+d) arrived."""
+        return (roll_from(send_flag_at_sender, d) & active
+                & ~(part_on & (roll_from(pid, d) != pid))
+                & (u >= loss_f))
+
+    # W1: ping i -> i+s.  Receiver j hears from sender j−s.
+    sel1 = sel_now(buddy_bits(target))
+    ok1 = wave_ok(prober & active, -s_off, rnd.loss_w1)   # per receiver j
+    win = win | jnp.where(ok1[:, None], roll_from(sel1, -s_off),
+                          jnp.uint32(0))
+    # W2: ack j=i+s -> i.  The ack sender is j (acks iff the ping arrived:
+    # ok1 is indexed by j already).  Receiver i hears from i+s.
+    sel2 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
+    ok2 = wave_ok(ok1, s_off, rnd.loss_w2)                # per receiver i
+    win = win | jnp.where(ok2[:, None], roll_from(sel2, s_off),
+                          jnp.uint32(0))
+    acked = ok2 & prober
+
+    need = prober & ~acked
+    relayed = jnp.zeros((n,), jnp.bool_)
+    for a in range(k):
+        q = rnd.q_off[a]
+        d4 = s_off - q
+        # W3: ping-req i -> i+q.  Receiver p hears from p−q.
+        sel3 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
+        ok3 = wave_ok(need, -q, rnd.loss_w3[:, a])        # per receiver p
+        win = win | jnp.where(ok3[:, None], roll_from(sel3, -q),
+                              jnp.uint32(0))
+        # W4: proxy ping p -> p+d4 (the original target j=i+s).  Receiver
+        # j hears from j−d4 = p.
+        sel4 = sel_now(buddy_bits(jnp.mod(ids + d4, n)))
+        ok4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a])        # per receiver j
+        win = win | jnp.where(ok4[:, None], roll_from(sel4, -d4),
+                              jnp.uint32(0))
+        # W5: target ack j -> j−d4 (back to proxy p).  Receiver p hears
+        # from p+d4.
+        sel5 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
+        ok5 = wave_ok(ok4, d4, rnd.loss_w5[:, a])         # per receiver p
+        win = win | jnp.where(ok5[:, None], roll_from(sel5, d4),
+                              jnp.uint32(0))
+        # W6: relay ack p -> p−q (back to prober i).  Receiver i hears
+        # from i+q.
+        sel6 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
+        ok6 = wave_ok(ok5, q, rnd.loss_w6[:, a])          # per receiver i
+        win = win | jnp.where(ok6[:, None], roll_from(sel6, q),
+                              jnp.uint32(0))
+        relayed = relayed | (ok6 & need)
+
+    # ---- Phase C: verdicts ------------------------------------------------
+    probe_ok = acked | relayed
+    failed = prober & ~probe_ok
+    lha = state.lha
+    s_probe = lha
+    if cfg.lifeguard:
+        lha = jnp.where(prober,
+                        jnp.clip(lha + jnp.where(failed, 1, -1), 0,
+                                 cfg.lha_max), lha)
+        thin = rnd.lha_u < (jnp.float32(1.0)
+                            / (1 + s_probe).astype(jnp.float32))
+        failed = failed & thin
+    viewed_tk = view_of(ids, target)
+    v_status = lattice.status_of(viewed_tk)
+    mk_suspect = failed & (v_status == 0)
+    re_suspect = failed & (v_status == 1)
+    susp_key = lattice.suspect_key(lattice.incarnation_of(viewed_tk))
+
+    # refutation: i knows a suspect rumor about i outranking its aliveness
+    self_key = jnp.where(knows_bit(ids, sus_slot[ids]), sus_bk[ids],
+                         jnp.uint32(0))
+    refute = active & lattice.is_suspect(self_key) & (
+        self_key > lattice.alive_key(state.inc_self))
+    new_inc = jnp.where(refute, lattice.incarnation_of(self_key) + 1,
+                        state.inc_self).astype(jnp.uint32)
+    inc_self = new_inc
+    if cfg.lifeguard:
+        lha = jnp.where(refute, jnp.clip(lha + 1, 0, cfg.lha_max), lha)
+
+    # sentinel expiry ([R]-sized)
+    filled = jnp.sum(snode >= 0, axis=-1).astype(jnp.int32)
+    if cfg.lifeguard and cfg.dynamic_suspicion:
+        from swim_tpu.models.rumor import dynamic_timeout_table
+        timeout = dynamic_timeout_table(cfg)[jnp.clip(filled, 0, s_cap)]
+    else:
+        timeout = jnp.full((r_tot,), cfg.suspicion_periods, jnp.int32)
+    sent_alive = (snode >= 0) & (plan.crash_step[jnp.maximum(snode, 0)] > t)
+    deadline_hit = sent_alive & (t >= stime + timeout[:, None])
+    is_susp_r = lattice.is_suspect(rkey)
+    subj_r = jnp.maximum(subject, 0)
+    higher_known = jnp.broadcast_to((gone_key[subj_r] > rkey)[:, None],
+                                    snode.shape)
+    for lvl in range(g.c):
+        oslot = top_slot[lvl][subj_r]                          # [R]
+        okey = top_key[lvl][subj_r]
+        cand = ((okey > rkey) & (oslot >= 0))[:, None]
+        kn = knows_bit(jnp.maximum(snode, 0),
+                       jnp.broadcast_to(oslot[:, None], snode.shape))
+        higher_known = higher_known | (cand & kn)
+    can_confirm = deadline_hit & ~higher_known
+    dead_key_r = lattice.dead_key(lattice.incarnation_of(rkey))
+    confirm = (used & is_susp_r & ~confirmed
+               & (dead_key_r > gone_key[subj_r])
+               & jnp.any(can_confirm, axis=-1))
+    conf_s = jnp.argmax(can_confirm, axis=-1)
+    conf_node = jnp.take_along_axis(snode, conf_s[:, None], axis=-1)[:, 0]
+
+    # ---- Phase D: new originations into the free fresh lanes --------------
+    # Channels, priority order: confirms > refutes > new/independent
+    # suspicions (carried lanes were already placed in Phase 0).
+    c_subj = jnp.concatenate([subject, ids, target])
+    c_key = jnp.concatenate([dead_key_r, lattice.alive_key(new_inc),
+                             susp_key])
+    c_orig = jnp.concatenate([jnp.maximum(conf_node, 0), ids, ids])
+    c_valid = jnp.concatenate([confirm, refute, mk_suspect | re_suspect])
+    c_srcslot = jnp.concatenate([rr, jnp.full((2 * n,), -1, jnp.int32)])
+    c_is_susp = jnp.concatenate([jnp.zeros((r_tot + n,), jnp.bool_),
+                                 jnp.ones((n,), jnp.bool_)])
+    m_cand = c_valid.shape[0]
+    total = jnp.sum(c_valid).astype(jnp.int32)
+    (ci,) = jnp.nonzero(c_valid, size=ob, fill_value=m_cand)
+    got = ci < m_cand
+    ci = jnp.minimum(ci, m_cand - 1)
+    subj_c = jnp.where(got, c_subj[ci], -1)
+    key_c = jnp.where(got, c_key[ci], 0)
+    orig_c = jnp.where(got, c_orig[ci], 0)
+    srcslot_c = jnp.where(got, c_srcslot[ci], -1)
+    susp_c = got & c_is_susp[ci]
+    overflow = overflow + jnp.maximum(total - ob, 0)
+
+    # dedup within candidates (earlier wins) and vs the live table
+    eq = ((subj_c[:, None] == subj_c[None, :])
+          & (key_c[:, None] == key_c[None, :]))
+    earlier = jnp.tril(jnp.ones((ob, ob), jnp.bool_), k=-1)
+    dup_mask = eq & earlier & got[None, :] & got[:, None]
+    dup_prev = jnp.any(dup_mask, axis=-1)
+    win_idx = jnp.argmax(dup_mask, axis=-1)
+    ex = (used[None, :] & (subj_c[:, None] == subject[None, :])
+          & (key_c[:, None] == rkey[None, :]))
+    ex_match = jnp.any(ex, axis=-1)
+    ex_slot = jnp.argmax(ex, axis=-1).astype(jnp.int32)
+
+    # free fresh lanes: those not carried in Phase 0
+    (free_lane,) = jnp.nonzero(~carry, size=ob, fill_value=ob)
+    n_free = jnp.sum(~carry).astype(jnp.int32)
+    place = got & ~dup_prev & ~ex_match
+    apos = jnp.cumsum(place.astype(jnp.int32)) - 1
+    alloc_ok = place & (apos < n_free)
+    lane_c = jnp.where(alloc_ok,
+                       free_lane[jnp.clip(apos, 0, ob - 1)], ob)
+    slot_new = jnp.where(alloc_ok,
+                         fresh_slots[jnp.clip(lane_c, 0, ob - 1)], -1)
+    overflow = overflow + jnp.sum(place & ~alloc_ok).astype(jnp.int32)
+    slot_f0 = jnp.where(ex_match, ex_slot, slot_new)
+    slot_f = jnp.where(dup_prev, slot_f0[win_idx], slot_f0).astype(jnp.int32)
+    placed = got & (slot_f >= 0)
+
+    wslot = jnp.where(alloc_ok, slot_f, r_tot)
+    subject = subject.at[wslot].set(subj_c, mode="drop")
+    rkey = rkey.at[wslot].set(key_c, mode="drop")
+    birth0 = birth0.at[wslot].set(t, mode="drop")
+    confirmed = confirmed.at[wslot].set(False, mode="drop")
+    snode = snode.at[wslot].set(-1, mode="drop")
+    stime = stime.at[wslot].set(0, mode="drop")
+
+    # originators hear their rumor: tiny scatter into the fresh win cols.
+    # scatter-ADD is scatter-OR here: the added one-hots live in freshly
+    # allocated free lanes, which are bit-disjoint from every bit already
+    # set in the word (carried lanes) and from each other (each lane is
+    # allocated once) — while scatter-max would REPLACE smaller existing
+    # bit patterns with the one-hot.
+    fw = jnp.clip(lane_c // WORD, 0, g.ow - 1)
+    fbit = (jnp.clip(lane_c, 0, ob - 1) % WORD).astype(jnp.uint32)
+    orig_rows = jnp.where(alloc_ok, orig_c, n)
+    win = win.at[orig_rows, g.ww - g.ow + fw].add(
+        jnp.where(alloc_ok, jnp.uint32(1) << fbit, jnp.uint32(0)),
+        mode="drop")
+
+    # sentinel joins (same scheme as the rumor engine)
+    joiner = placed & susp_c
+    tgt_r = jnp.where(joiner, slot_f, r_tot)
+    already = jnp.any(snode[jnp.clip(tgt_r, 0, r_tot - 1)]
+                      == orig_c[:, None], axis=-1) & joiner
+    joiner = joiner & ~already
+    tgt_r = jnp.where(joiner, slot_f, r_tot)
+    same_r = (tgt_r[:, None] == tgt_r[None, :])
+    grp_rank = jnp.sum(same_r & earlier & joiner[None, :],
+                       axis=-1).astype(jnp.int32)
+    fill_now = jnp.sum(snode[jnp.clip(tgt_r, 0, r_tot - 1)] >= 0,
+                       axis=-1).astype(jnp.int32)
+    spos = fill_now + grp_rank
+    j_ok = joiner & (spos < s_cap)
+    wr = jnp.where(j_ok, tgt_r, r_tot)
+    ws = jnp.clip(spos, 0, s_cap - 1)
+    snode = snode.at[wr, ws].set(orig_c, mode="drop")
+    stime = stime.at[wr, ws].set(t, mode="drop")
+
+    conf_slot = jnp.where(placed & (srcslot_c >= 0), srcslot_c, r_tot)
+    confirmed = confirmed.at[conf_slot].set(True, mode="drop")
+
+    # inactive nodes are frozen
+    inc_self = jnp.where(active, inc_self, state.inc_self)
+    lha = jnp.where(active, lha, state.lha)
+
+    return RingState(
+        win=win, cold=cold, inc_self=inc_self, lha=lha, gone_key=gone_key,
+        subject=subject, rkey=rkey, birth0=birth0,
+        sent_node=snode, sent_time=stime, confirmed=confirmed,
+        overflow=overflow, index_overflow=index_overflow, step=t + 1,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run(cfg: SwimConfig, state: RingState, plan: FaultPlan,
+        root_key: jax.Array, periods: int) -> RingState:
+    """Run `periods` protocol periods under one fused lax.scan."""
+
+    def body(stt, _):
+        rnd = draw_period_ring(root_key, stt.step, cfg)
+        return step(cfg, stt, plan, rnd), None
+
+    state, _ = jax.lax.scan(body, state, None, length=periods)
+    return state
+
+
+class RingEngine:
+    """Convenience wrapper holding (cfg, plan, state) with a jitted step."""
+
+    def __init__(self, cfg: SwimConfig, plan: FaultPlan,
+                 root_key: jax.Array | None = None):
+        self.cfg = cfg
+        self.plan = plan
+        self.root_key = (root_key if root_key is not None
+                         else jax.random.key(0))
+        self.state = init_state(cfg)
+        self._step = jax.jit(functools.partial(step, cfg))
+
+    def run(self, periods: int) -> RingState:
+        self.state = run(self.cfg, self.state, self.plan, self.root_key,
+                         periods)
+        return self.state
+
+    def step_once(self, rnd: RingRandomness | None = None) -> RingState:
+        if rnd is None:
+            rnd = draw_period_ring(self.root_key, self.state.step, self.cfg)
+        self.state = self._step(self.state, self.plan, rnd)
+        return self.state

@@ -218,7 +218,12 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     ids = jnp.arange(n, dtype=jnp.int32)
     rr = jnp.arange(r_cap, dtype=jnp.int32)
     crashed = t >= plan.crash_step
-    up = ~crashed
+    joined = t >= plan.join_step
+    # `up` is full membership activity: joined and not crashed. Nodes with
+    # a future join_step neither act nor receive, are skipped as probe
+    # targets (not in anyone's membership list yet), and count toward
+    # dissemination totals only once joined.
+    up = ~crashed & joined
     part_on = (t >= plan.partition_start) & (t < plan.partition_end)
 
     # ---- Phase 0: retire stale rumors (docstring deviation 1/4) -----------
@@ -267,18 +272,20 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
 
     if cfg.target_selection == "round_robin":
         # §4.3 Feistel round-robin (same schedule as the dense engine);
-        # believed-dead targets are probed and fail fast — no resampling
+        # believed-dead targets are probed and fail fast — no resampling.
+        # A not-yet-joined target is no probe at all (idle period): it is
+        # in nobody's membership list.
         epoch = jnp.broadcast_to(t // jnp.int32(n - 1), (n,))
         pos = jnp.broadcast_to(t % jnp.int32(n - 1), (n,))
         target = sampling.round_robin_target(ids, epoch, pos, n)
-        prober = up
+        prober = up & joined[target]
     else:
         target = draw_tgt(base.target_u)
-        bad = _believes_dead(st, target)
+        bad = _believes_dead(st, target) | ~joined[target]
         for a in range(RESAMPLE_ATTEMPTS):
             nxt = draw_tgt(rnd.resample_u[:, a])
             target = jnp.where(bad, nxt, target)
-            bad = bad & _believes_dead(st, target)
+            bad = bad & (_believes_dead(st, target) | ~joined[target])
         prober = up & ~bad & (n >= 2)
 
     # proxies: uniform over j ∉ {i, T(i)} — the dense masked-CDF mapping
@@ -292,7 +299,7 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
 
     def delivered(src, dst, u):
         cut = part_on & (plan.partition_id[src] != plan.partition_id[dst])
-        return (~crashed[src] & ~crashed[dst] & ~cut
+        return (up[src] & up[dst] & ~cut
                 & (u >= plan.loss.astype(jnp.float32)))
 
     # ---- Phase B: global piggyback candidates (deviation 1) ---------------
@@ -555,12 +562,13 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     conf_ok_slot = jnp.where(placed & (src_c >= 0), src_c, r_cap)
     confirmed = confirmed.at[conf_ok_slot].set(True, mode="drop")
 
-    # Crashed nodes are frozen by construction: delivered() blocks receipt,
-    # and every origination path (prober/refute/sentinel) requires liveness.
-    # Their heard-bits for *reused* slots are still cleared above — a frozen
-    # row only stays meaningful for rumors that are still in the table.
-    inc_self = jnp.where(crashed, state.inc_self, inc_self)
-    lha = jnp.where(crashed, state.lha, lha)
+    # Inactive (crashed or not-yet-joined) nodes are frozen by
+    # construction: delivered() blocks receipt, and every origination path
+    # (prober/refute/sentinel) requires activity. Their heard-bits for
+    # *reused* slots are still cleared above — a frozen row only stays
+    # meaningful for rumors that are still in the table.
+    inc_self = jnp.where(~up, state.inc_self, inc_self)
+    lha = jnp.where(~up, state.lha, lha)
 
     return RumorState(
         knows=knows, inc_self=inc_self, lha=lha, gone_key=gone_key,

@@ -114,8 +114,14 @@ class RumorOracle:
     def crashed(self, i: int, t: int) -> bool:
         return t >= int(self.plan.crash_step[i])
 
+    def joined(self, i: int, t: int) -> bool:
+        return t >= int(self.plan.join_step[i])
+
+    def active(self, i: int, t: int) -> bool:
+        return self.joined(i, t) and not self.crashed(i, t)
+
     def delivered(self, src: int, dst: int, t: int, u_loss) -> bool:
-        if self.crashed(src, t) or self.crashed(dst, t):
+        if not (self.active(src, t) and self.active(dst, t)):
             return False
         p = self.plan
         if (int(p.partition_start) <= t < int(p.partition_end)
@@ -160,7 +166,7 @@ class RumorOracle:
         t = st.step
         base = _prng.to_numpy(rnd.base)
         resample_u = np.asarray(rnd.resample_u)
-        up = [i for i in range(n) if not self.crashed(i, t)]
+        up = [i for i in range(n) if self.active(i, t)]
         up_set = set(up)
 
         # ---- Phase 0: retirement (rumor.py deviation 1 + tombstones) ----
@@ -214,16 +220,19 @@ class RumorOracle:
             epoch, pos = divmod(t, n - 1)
             for i in range(n):
                 target[i] = py_round_robin_target(i, epoch, pos, n)
-            prober = set(up)
+            prober = {i for i in up if self.joined(target[i], t)}
         else:
+            def bad_tgt(i, ti):
+                return self._believes_dead(i, ti) or not self.joined(ti, t)
+
             for i in range(n):
                 ti = draw_tgt(i, base.target_u[i])
-                bad = self._believes_dead(i, ti)
+                bad = bad_tgt(i, ti)
                 for a in range(RESAMPLE_ATTEMPTS):
                     nxt = draw_tgt(i, resample_u[i, a])
                     if bad:
                         ti = nxt
-                        bad = self._believes_dead(i, ti)
+                        bad = bad_tgt(i, ti)
                 target[i] = ti
                 if i in up_set and not bad and n >= 2:
                     prober.add(i)

@@ -120,7 +120,8 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
         subject=P(), rkey=P(), birth=P(), sent_node=P(), sent_time=P(),
         confirmed=P(), overflow=P(), step=P())
     plan_specs = FaultPlan(crash_step=P(), loss=P(), partition_id=P(),
-                           partition_start=P(), partition_end=P())
+                           partition_start=P(), partition_end=P(),
+                           join_step=P())
     rnd_specs = RumorRandomness(
         base=jax.tree.map(lambda _: P(AX), rumor.draw_period_rumor(
             jax.random.key(0), 0, cfg).base),
@@ -577,7 +578,13 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
         shard_body, mesh=mesh,
         in_specs=(node_specs, plan_specs, rnd_specs),
         out_specs=node_specs, check_vma=False)
-    return jax.jit(smapped)
+    jitted = jax.jit(smapped)
+
+    def stepper(state: RumorState, plan: FaultPlan, rnd):
+        _reject_join_plans(plan)
+        return jitted(state, plan, rnd)
+
+    return stepper
 
 
 @functools.lru_cache(maxsize=32)
@@ -595,12 +602,39 @@ def build_run(cfg: SwimConfig, mesh, periods: int,
         out, _ = jax.lax.scan(body, state, None, length=periods)
         return out
 
-    return jax.jit(runner)
+    jitted = jax.jit(runner)
+
+    def guarded(state: RumorState, plan: FaultPlan, root_key):
+        _reject_join_plans(plan)
+        return jitted(state, plan, root_key)
+
+    return guarded
+
+
+def _reject_join_plans(plan: FaultPlan) -> None:
+    """This engine does not model join churn (FaultPlan docstring
+    contract): refuse concrete plans with a join schedule. Traced values
+    (inside an outer jit, already guarded at its concrete boundary) pass
+    through."""
+    import numpy as np
+
+    js = plan.join_step
+    if isinstance(js, jax.core.Tracer):
+        return
+    try:
+        concrete = np.asarray(js)
+    except Exception:
+        return
+    if np.any(concrete > 0):
+        raise NotImplementedError(
+            "the sharded exchange engine does not model join churn yet — "
+            "use the ring, rumor, or dense engine for join schedules")
 
 
 def place(cfg: SwimConfig, mesh, state: RumorState, plan: FaultPlan):
     """Device-put state/plan with this engine's placement (plan and
     gone_key replicated, node-axis tensors sharded)."""
+    _reject_join_plans(plan)
     from jax.sharding import NamedSharding
 
     node_sh = NamedSharding(mesh, P(AX))

@@ -33,6 +33,18 @@ class FaultPlan(NamedTuple):
     partition_id: jax.Array     # i32[N] group labels
     partition_start: jax.Array  # i32 scalar (period, inclusive)
     partition_end: jax.Array    # i32 scalar (period, exclusive)
+    join_step: jax.Array        # i32[N], period a node becomes a member
+    #                              (<= 0 = founding member). The dense,
+    #                              rumor, and ring engines model join as
+    #                              activation: a not-yet-joined node
+    #                              neither acts nor receives and is in
+    #                              nobody's membership list (no probes of
+    #                              it); the sharded exchange engine raises
+    #                              on join schedules. SWIM's snapshot
+    #                              handshake lives in the real-node runtime
+    #                              (core/node.py JOIN). Rejoin after DEAD
+    #                              is a join under a fresh id, per the
+    #                              protocol's rejoin-as-new-member rule.
 
 
 def none(n: int) -> FaultPlan:
@@ -43,7 +55,16 @@ def none(n: int) -> FaultPlan:
         partition_id=jnp.zeros((n,), jnp.int32),
         partition_start=jnp.int32(0),
         partition_end=jnp.int32(0),
+        join_step=jnp.zeros((n,), jnp.int32),
     )
+
+
+def with_joins(plan: FaultPlan, node_ids, at_step) -> FaultPlan:
+    """Nodes that join (or rejoin under a fresh id) at the given period."""
+    node_ids = jnp.asarray(node_ids, jnp.int32)
+    at = jnp.broadcast_to(jnp.asarray(at_step, jnp.int32), node_ids.shape)
+    return plan._replace(
+        join_step=plan.join_step.at[node_ids].max(at))
 
 
 def with_loss(plan: FaultPlan, loss: float) -> FaultPlan:

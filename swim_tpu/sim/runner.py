@@ -60,9 +60,11 @@ class StudyResult(NamedTuple):
 
 
 def _update_track(track: StudyTrack, state: dense.DenseState,
-                  crashed: jax.Array, t: jax.Array) -> StudyTrack:
+                  crashed: jax.Array, t: jax.Array,
+                  live: jax.Array) -> StudyTrack:
+    """`crashed` selects which subjects accrue detection milestones;
+    `live` (crash- AND join-aware) selects who counts as an observer."""
     key = state.key
-    live = ~crashed
     not_alive_view = lattice.is_suspect(key) | lattice.is_dead(key)
     dead_view = lattice.is_dead(key)
     live_col = live[:, None]
@@ -96,9 +98,10 @@ def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
         # period just executed
         t = st.step - 1
         crashed = t >= plan.crash_step
-        track = _update_track(track, st, crashed, t)
-        live_col = (~crashed)[:, None]
-        live_row = (~crashed)[None, :]
+        live = ~crashed & (t >= plan.join_step)
+        track = _update_track(track, st, crashed, t, live=live)
+        live_col = live[:, None]
+        live_row = live[None, :]
         susp = lattice.is_suspect(st.key)
         dead = lattice.is_dead(st.key)
         series = (
@@ -176,7 +179,7 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
             st = step_fn(st, plan, rnd)
         t = st.step - 1
         crashed = t >= plan.crash_step
-        up = ~crashed
+        up = ~crashed & (t >= plan.join_step)
         not_alive, dead_seen, dead_all, counts = _rumor_subject_flags(
             cfg, st, up)
 

@@ -137,3 +137,16 @@ def test_scan_run_matches_python_loop():
     st_scan = dense.run(cfg, dense.init_state(cfg), plan, key, 12)
     for a, b in zip(st_scan, st_loop):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestJoinChurn:
+    def test_join_crash_bitwise(self):
+        """FaultPlan.join_step activation churn in the dense engine,
+        bitwise vs the scalar oracle (uniform + round-robin modes)."""
+        for sel in ("uniform", "round_robin"):
+            n = 20
+            cfg = SwimConfig(n_nodes=n, target_selection=sel)
+            plan = faults.with_joins(faults.none(n), [16, 17], [4])
+            plan = faults.with_crashes(plan, [2, 16], [8])
+            plan = faults.with_loss(plan, 0.1)
+            run_both(cfg, plan, 6, 16)

@@ -1,0 +1,166 @@
+"""Ring engine vs its scalar oracle: bitwise, full lifecycle — plus the
+engine-level behavior checks (detection, FP suppression, join churn).
+
+The comparison masks exactly what the packed representation leaves
+undefined: table metadata is compared only on live slots (subject >= 0 —
+freed slots legitimately hold stale values), and the cold heard-bit store
+is compared only on non-window ring columns (the engine flushes a window
+column into cold lazily, so cold's copy of a CURRENT window column is one
+generation stale by design).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import ring, ring_oracle
+from swim_tpu.sim import faults
+from swim_tpu.types import Status, key_status
+
+
+def assert_states_equal(orc: ring_oracle.RingOracle, est, t):
+    st = orc.state
+    win, cold, win_cols = orc.packed_state()
+    np.testing.assert_array_equal(win, np.asarray(est.win),
+                                  err_msg=f"win @ period {t}")
+    e_cold = np.asarray(est.cold)
+    mask = np.ones(cold.shape[1], bool)
+    mask[win_cols] = False
+    np.testing.assert_array_equal(cold[:, mask], e_cold[:, mask],
+                                  err_msg=f"cold @ period {t}")
+    np.testing.assert_array_equal(st.subject, np.asarray(est.subject),
+                                  err_msg=f"subject @ period {t}")
+    live = st.subject >= 0
+    for name in ("rkey", "birth0", "sent_node", "sent_time", "confirmed"):
+        a = getattr(st, name)
+        b = np.asarray(getattr(est, name))
+        np.testing.assert_array_equal(a[live], b[live],
+                                      err_msg=f"{name} @ period {t}")
+    for name in ("inc_self", "lha", "gone_key"):
+        np.testing.assert_array_equal(
+            getattr(st, name), np.asarray(getattr(est, name)),
+            err_msg=f"{name} @ period {t}")
+    assert int(st.overflow) == int(est.overflow), t
+    assert int(st.index_overflow) == int(est.index_overflow), t
+
+
+def run_both(cfg, plan, periods, seed=7):
+    key = jax.random.key(seed)
+    orc = ring_oracle.RingOracle(cfg, plan)
+    est = ring.init_state(cfg)
+    step = jax.jit(lambda s, r: ring.step(cfg, s, plan, r))
+    for t in range(periods):
+        rnd = ring.draw_period_ring(key, t, cfg)
+        orc.step(rnd)
+        est = step(est, rnd)
+        assert_states_equal(orc, est, t)
+    return orc.state, est
+
+
+class TestBitwiseVsOracle:
+    def test_crash_full_lifecycle(self):
+        """Crash through suspicion, sentinel expiry, death dissemination,
+        recycling, and tombstoning — every phase, bitwise."""
+        n = 32
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_crashes(faults.none(n), [5], [2])
+        orc, _ = run_both(cfg, plan, 26)
+        assert key_status(int(orc.gone_key[5])) == Status.DEAD
+        assert orc.overflow == 0
+
+    def test_loss_refutation(self):
+        """Loss-induced false suspicion is refuted; the dissemination
+        floor (generalized gone_key) suppresses late expiry."""
+        n = 32
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_loss(faults.none(n), 0.08)
+        orc, _ = run_both(cfg, plan, 30, seed=3)
+        # no false deaths despite suspicion traffic
+        assert not any(key_status(int(k)) == Status.DEAD
+                       for k in orc.gone_key)
+
+    def test_partition(self):
+        n = 24
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_loss(faults.none(n), 0.05)
+        plan = faults.with_partition(plan, faults.halves(n), 3, 9)
+        run_both(cfg, plan, 16, seed=4)
+
+    def test_join_churn(self):
+        """Late joiners + crash + rejoin-as-fresh-id, bitwise."""
+        n = 24
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_joins(faults.none(n), [20, 21], [5])
+        plan = faults.with_crashes(plan, [3, 20], [9])
+        plan = faults.with_joins(plan, [22], [12])   # "rejoin" of 3
+        orc, _ = run_both(cfg, plan, 24, seed=5)
+        assert key_status(int(orc.gone_key[3])) == Status.DEAD
+        assert key_status(int(orc.gone_key[20])) == Status.DEAD
+        # live joiners must NOT be suspected/killed for their pre-join
+        # silence (they were in nobody's membership list)
+        for alive_joiner in (21, 22):
+            assert key_status(int(orc.gone_key[alive_joiner])) \
+                != Status.DEAD, alive_joiner
+
+    def test_lifeguard_dynamic(self):
+        """Full Lifeguard arm: LHA thinning, buddy forcing, dynamic
+        sentinel timeouts — bitwise."""
+        n = 32
+        cfg = SwimConfig(n_nodes=n, lifeguard=True, dynamic_suspicion=True,
+                         buddy=True)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [4, 19], [2]), 0.1)
+        run_both(cfg, plan, 22, seed=2)
+
+    def test_tiny_budget_overflow(self):
+        """One origination word under mass churn: budget overflow paths
+        agree bitwise."""
+        n = 24
+        cfg = SwimConfig(n_nodes=n, ring_orig_words=1)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [3, 11, 17], [1]), 0.25)
+        orc, _ = run_both(cfg, plan, 14, seed=5)
+
+
+class TestBehavior:
+    """Engine-level protocol behavior (no oracle; bigger N)."""
+
+    def test_rotor_detection_is_fast(self):
+        """Rotor round-robin detects a crash within a few periods —
+        the SWIM §4.3 bounded-detection regime (deviation R1)."""
+        n = 256
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_crashes(faults.none(n), [40], [3])
+        eng = ring.RingEngine(cfg, plan, jax.random.key(0))
+        eng.run(6)
+        sub = np.asarray(eng.state.subject)
+        k = np.asarray(eng.state.rkey)
+        got = ((sub == 40) & ((k & 1) == 1)).any() \
+            or key_status(int(eng.state.gone_key[40])) == Status.DEAD
+        assert got, "crash not suspected within 3 periods of the crash"
+
+    def test_death_disseminates_and_tombstones(self):
+        """The recycling mechanism completes death dissemination (the
+        rumor engine's global age window stalled at this size)."""
+        n = 4096
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_crashes(faults.none(n), [7, 1000, 3000], [2])
+        eng = ring.RingEngine(cfg, plan, jax.random.key(1))
+        eng.run(60)
+        gk = np.asarray(eng.state.gone_key)
+        for v in (7, 1000, 3000):
+            assert key_status(int(gk[v])) == Status.DEAD, v
+        assert int(eng.state.overflow) == 0
+
+    def test_no_false_positives_under_loss(self):
+        n = 512
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_loss(faults.none(n), 0.05)
+        eng = ring.RingEngine(cfg, plan, jax.random.key(2))
+        eng.run(60)
+        gk = np.asarray(eng.state.gone_key)
+        assert not ((gk >> 31) == 1).any()
+        # suspicion + refutation actually happened
+        assert int(np.asarray(eng.state.inc_self, np.int64).sum()) > 0

@@ -111,3 +111,30 @@ class TestLifeguard:
         plan = faults.with_loss(
             faults.with_crashes(faults.none(n), [7], [1]), 0.2)
         run_both(cfg, plan, 18, seed=9)
+
+
+class TestJoinChurn:
+    def test_join_crash_rejoin_bitwise(self):
+        """Join-as-activation churn (FaultPlan.join_step): late joiners,
+        a crash among them, and a rejoin under a fresh id — bitwise."""
+        n = 28
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=64)
+        plan = faults.with_joins(faults.none(n), [24, 25], [4])
+        plan = faults.with_crashes(plan, [2, 24], [8])
+        plan = faults.with_joins(plan, [26], [10])
+        plan = faults.with_loss(plan, 0.1)
+        orc, _, _ = run_both(cfg, plan, 20, seed=6)
+        from swim_tpu.types import Status, key_status
+
+        # late-but-alive joiners are never tombstoned for pre-join silence
+        for alive_joiner in (25, 26):
+            assert key_status(int(orc.gone_key[alive_joiner])) \
+                != Status.DEAD
+
+    def test_round_robin_join_bitwise(self):
+        n = 20
+        cfg = SwimConfig(n_nodes=n, rumor_capacity=64,
+                         target_selection="round_robin")
+        plan = faults.with_joins(faults.none(n), [17], [3])
+        plan = faults.with_crashes(plan, [5], [6])
+        run_both(cfg, plan, 16, seed=8)

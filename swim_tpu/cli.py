@@ -116,7 +116,12 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         def do_run(st):
             return run_fn(st, plan, jax.random.key(args.seed))
     else:
-        mod = dense if engine == "dense" else rumor
+        if engine == "dense":
+            mod = dense
+        elif engine == "ring":
+            from swim_tpu.models import ring as mod
+        else:
+            mod = rumor
         state = pmesh.shard_state(mod.init_state(cfg), mesh, n=args.nodes)
         plan = pmesh.shard_state(plan, mesh, n=args.nodes)
 
@@ -139,6 +144,8 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     live = ~crashed
     if engine == "dense":
         dead_views = np.asarray(lattice.is_dead(state.key))
+    elif engine == "ring":
+        dead_views = None          # summarized via the dissemination floor
     else:
         dead_views = np.asarray(lattice.is_dead(
             rumor.view_matrix(cfg, state))) if args.nodes <= 8192 else None
@@ -242,7 +249,7 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--crash-fraction", type=float, default=0.01)
     sim.add_argument("--suspicion-mult", type=float, default=5.0)
     sim.add_argument("--lifeguard", action="store_true")
-    sim.add_argument("--engine", choices=("auto", "dense", "rumor", "shard"),
+    sim.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring"),
                      default="auto")
     sim.add_argument("--profile", default="",
                      help="write a jax.profiler device trace to this dir")
@@ -255,7 +262,7 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--nodes", type=int, default=1000)
     st.add_argument("--periods", type=int, default=100)
     st.add_argument("--seed", type=int, default=0)
-    st.add_argument("--engine", choices=("auto", "dense", "rumor", "shard"),
+    st.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring"),
                     default="auto")
     st.add_argument("--crash-fraction", type=float, default=0.01)
     st.add_argument("--loss", type=float, default=0.05)

@@ -242,6 +242,23 @@ def _select_first_b(win_masked, b: int):
     return jnp.stack(taken, axis=-1)
 
 
+def resolved_words(cfg: SwimConfig, state: RingState) -> jax.Array:
+    """u32[N, RW]: the CURRENT heard-bits of every ring word.
+
+    Resolves the win/cold split (window words live in `win`; cold's copy
+    of a window column is one generation stale by design) using the slot
+    arithmetic this module owns — external consumers (study runner,
+    metrics) must use this instead of re-deriving the layout.
+    """
+    g = geometry(cfg)
+    first_gw = state.step * g.ow - g.ww       # win col 0 after the last step
+    win_ring0 = jnp.mod(first_gw, g.rw)
+    word_off = jnp.mod(jnp.arange(g.rw, dtype=jnp.int32) - win_ring0, g.rw)
+    in_win = word_off < g.ww
+    wcol = jnp.clip(word_off, 0, g.ww - 1)
+    return jnp.where(in_win[None, :], state.win[:, wcol], state.cold)
+
+
 def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
          rnd: RingRandomness) -> RingState:
     """One protocol period for all N nodes (pure; jit with cfg static)."""

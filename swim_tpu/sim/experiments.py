@@ -56,6 +56,11 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
         return runner.run_study(cfg, state, plan, key, periods)
+    if engine == "ring":
+        from swim_tpu.models import ring
+
+        state = pmesh.shard_state(ring.init_state(cfg), mesh, n=n)
+        return runner.run_study_ring(cfg, state, plan, key, periods)
     state = pmesh.shard_state(rumor.init_state(cfg), mesh, n=n)
     return runner.run_study_rumor(cfg, state, plan, key, periods)
 
@@ -75,7 +80,7 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
            "suspicion_periods": cfg.suspicion_periods}
     out.update(runner.detection_summary(res, plan, periods))
     out.update(metrics.series_digest(res.series))
-    if engine in ("rumor", "shard"):
+    if engine in ("rumor", "shard", "ring"):
         out["overflow"] = int(res.state.overflow)
     return out
 
@@ -114,7 +119,7 @@ def fp_sweep(n: int = 100_000, losses: tuple = (0.0, 0.1, 0.2, 0.3),
             "max_incarnation": int(np.asarray(
                 series.max_incarnation).max()),
         }
-        if engine in ("rumor", "shard"):
+        if engine in ("rumor", "shard", "ring"):
             pt["overflow"] = int(res.state.overflow)
         points.append(pt)
     return {"study": "fp_sweep", "n": n, "periods": periods,

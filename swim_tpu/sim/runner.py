@@ -123,29 +123,27 @@ class RumorStudyResult(NamedTuple):
     series: PeriodSeries
 
 
-def _rumor_subject_flags(cfg: SwimConfig, st, up: jax.Array):
-    """Per-subject (not-alive-seen, dead-seen, dead-disseminated) bool[N].
+def _subject_flags(n: int, subject, rkey, knowers, up,
+                   gone_not_alive, gone_dead):
+    """Per-subject (not-alive-seen, dead-seen, dead-disseminated) bool[N]
+    plus knower-weighted (suspect, dead) view counts — shared by the
+    rumor- and ring-engine study runners.
 
     A subject's milestone fires when a matching rumor is known by ≥1 live
     node (all live nodes, for dissemination) or has retired into the
-    `gone_key` tombstone (which by construction implies full dissemination).
-    View-based and rumor-based milestones coincide for crashed subjects,
-    who can never refute (the one divergence: a stale pre-crash refutation
-    outranking a stale suspicion — absent by construction here, since
-    tracked subjects stop acting at their crash step).
+    dissemination floor. `gone_not_alive`/`gone_dead` split because the
+    ring engine's floor can hold ALIVE/SUSPECT keys (any disseminated
+    retired key) while the rumor engine's holds only death tombstones.
     """
-    n = cfg.n_nodes
-    used = st.subject >= 0
+    used = subject >= 0
     live_total = jnp.sum(up).astype(jnp.int32)
-    knowers = jnp.sum(st.knows & up[:, None], axis=0).astype(jnp.int32)
-    is_s = lattice.is_suspect(st.rkey)
-    is_d = lattice.is_dead(st.rkey)
+    is_s = lattice.is_suspect(rkey)
+    is_d = lattice.is_dead(rkey)
     known = used & (knowers > 0)
-    sub = jnp.where(used, st.subject, n)
+    sub = jnp.where(used, subject, n)
     zeros = jnp.zeros((n,), jnp.bool_)
-    gone_dead = lattice.is_dead(st.gone_key)
     not_alive = (zeros.at[sub].max(known & (is_s | is_d), mode="drop")
-                 | gone_dead)
+                 | gone_not_alive)
     dead_seen = zeros.at[sub].max(known & is_d, mode="drop") | gone_dead
     dead_all = (zeros.at[sub].max(used & is_d & (knowers >= live_total),
                                   mode="drop") | gone_dead)
@@ -155,6 +153,25 @@ def _rumor_subject_flags(cfg: SwimConfig, st, up: jax.Array):
         + jnp.sum(gone_dead) * live_total,
     )
     return not_alive, dead_seen, dead_all, counts
+
+
+def _false_dead_views(subject, rkey, knowers, up, gone_dead):
+    """Knower-weighted DEAD views whose subject is actually alive."""
+    used = subject >= 0
+    live_total = jnp.sum(up).astype(jnp.int32)
+    live_subj = up[jnp.maximum(subject, 0)]
+    return (jnp.sum(jnp.where(used & lattice.is_dead(rkey) & live_subj,
+                              knowers, 0))
+            + jnp.sum(gone_dead & up) * live_total).astype(jnp.int32)
+
+
+def _rumor_subject_flags(cfg: SwimConfig, st, up: jax.Array):
+    """Rumor-engine adapter over _subject_flags (knowers from the bool
+    heard-matrix; the tombstone floor only ever holds DEAD keys)."""
+    knowers = jnp.sum(st.knows & up[:, None], axis=0).astype(jnp.int32)
+    gone_dead = lattice.is_dead(st.gone_key)
+    return _subject_flags(cfg.n_nodes, st.subject, st.rkey, knowers, up,
+                          gone_dead, gone_dead)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 4, 5))
@@ -192,15 +209,9 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
             first_dead_view=first(track.first_dead_view, dead_seen),
             disseminated=first(track.disseminated, dead_all),
         )
-        # dead views whose subject is actually alive (live viewers only)
-        used_r = st.subject >= 0
-        live_subj = up[jnp.maximum(st.subject, 0)]
-        live_total = jnp.sum(up).astype(jnp.int32)
         knowers = jnp.sum(st.knows & up[:, None], axis=0).astype(jnp.int32)
-        false_dead = (jnp.sum(jnp.where(
-            used_r & lattice.is_dead(st.rkey) & live_subj, knowers, 0))
-            + jnp.sum(lattice.is_dead(st.gone_key) & up) * live_total
-        ).astype(jnp.int32)
+        false_dead = _false_dead_views(st.subject, st.rkey, knowers, up,
+                                       lattice.is_dead(st.gone_key))
         series = (counts[0], counts[1], false_dead,
                   jnp.maximum(
                       jnp.max(lattice.incarnation_of(st.rkey)),
@@ -210,6 +221,81 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
     (state, track), series = jax.lax.scan(body, (state, track0), None,
                                           length=periods)
     return RumorStudyResult(state, track, PeriodSeries(*series))
+
+
+class RingStudyResult(NamedTuple):
+    state: "ring.RingState"
+    track: StudyTrack
+    series: PeriodSeries
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
+                   root_key: jax.Array, periods: int) -> RingStudyResult:
+    """Ring-engine study: the same StudyTrack/PeriodSeries as the other
+    engines, computed from the packed heard-bit words.
+
+    Per-slot knower COUNTS require unpacking the bit-planes ([N, R] work
+    per period), which is fine at study sizes; the throughput bench path
+    never runs this. The `disseminated` milestone uses the engine's
+    dissemination floor (gone_key), which a death reaches when its word
+    retires after full dissemination — i.e. the milestone can lag true
+    dissemination by up to the window length (ring.py deviation R2);
+    first_suspect / first_dead_view are exact (any-live-knower word ORs).
+    """
+    from swim_tpu.models import ring as ring_mod
+
+    n = cfg.n_nodes
+    g = ring_mod.geometry(cfg)
+    r_tot = g.rw * ring_mod.WORD
+    track0 = StudyTrack(*(jnp.full((n,), NEVER, jnp.int32)
+                          for _ in range(3)))
+
+    def body(carry, _):
+        st, track = carry
+        rnd = ring_mod.draw_period_ring(root_key, st.step, cfg)
+        st = ring_mod.step(cfg, st, plan, rnd)
+        t = st.step - 1
+        crashed = t >= plan.crash_step
+        up = ~crashed & (t >= plan.join_step)
+
+        # per-slot live-knower counts from the packed planes (layout
+        # resolution owned by ring.resolved_words); the bit-unpack fuses
+        # into the reduction
+        words = ring_mod.resolved_words(cfg, st)
+        live_words = jnp.where(up[:, None], words, jnp.uint32(0))
+        bits = (live_words[:, :, None]
+                >> jnp.arange(ring_mod.WORD, dtype=jnp.uint32)[None, None, :]
+                ) & jnp.uint32(1)
+        knowers = jnp.sum(bits, axis=0).reshape(r_tot).astype(jnp.int32)
+
+        gone = st.gone_key
+        gone_not_alive = lattice.is_suspect(gone) | lattice.is_dead(gone)
+        gone_dead = lattice.is_dead(gone)
+        not_alive, dead_seen, dead_all, counts = _subject_flags(
+            n, st.subject, st.rkey, knowers, up, gone_not_alive, gone_dead)
+
+        def first(cur, cond):
+            hit = cond & crashed & (cur == NEVER)
+            return jnp.where(hit, t, cur)
+
+        track = StudyTrack(
+            first_suspect=first(track.first_suspect, not_alive),
+            first_dead_view=first(track.first_dead_view, dead_seen),
+            disseminated=first(track.disseminated, dead_all),
+        )
+        false_dead = _false_dead_views(st.subject, st.rkey, knowers, up,
+                                       gone_dead)
+        series = (
+            counts[0], counts[1], false_dead,
+            jnp.maximum(jnp.max(lattice.incarnation_of(st.rkey)),
+                        jnp.max(st.inc_self)).astype(jnp.int32),
+        )
+        return (st, track), series
+
+    (state, track), series = jax.lax.scan(body, (state, track0), None,
+                                          length=periods)
+    return RingStudyResult(state, track, PeriodSeries(*series))
 
 
 def detection_summary(result: StudyResult, plan: FaultPlan,

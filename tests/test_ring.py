@@ -164,3 +164,40 @@ class TestBehavior:
         assert not ((gk >> 31) == 1).any()
         # suspicion + refutation actually happened
         assert int(np.asarray(eng.state.inc_self, np.int64).sum()) > 0
+
+
+class TestStudyRunner:
+    def test_ring_study_parity_with_dense(self):
+        """runner.run_study_ring agrees with the dense-engine study where
+        the engines' documented deviations allow: same crashes detected,
+        same final knower-weighted dead-view count once dissemination and
+        tombstoning complete, zero false deaths, and rotor detection
+        latency at the deterministic bound (ring.py deviation R1)."""
+        import jax
+
+        from swim_tpu.models import dense, ring
+        from swim_tpu.sim import runner
+
+        n, periods = 128, 60
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_crashes(faults.none(n), [11, 70], [3])
+        res_r = runner.run_study_ring(cfg, ring.init_state(cfg), plan,
+                                      jax.random.key(0), periods)
+        res_d = runner.run_study(cfg, dense.init_state(cfg), plan,
+                                 jax.random.key(0), periods)
+        sum_r = runner.detection_summary(res_r, plan, periods)
+        sum_d = runner.detection_summary(res_d, plan, periods)
+        assert sum_r["crashed"] == sum_d["crashed"] == 2
+        assert sum_r["suspect_detected"] == 2
+        assert sum_d["suspect_detected"] == 2
+        # rotor: every node is probed every period -> detection in 1
+        assert sum_r["suspect_latency_mean"] == 1.0
+        assert sum_r["disseminated_detected"] == 2
+        assert sum_d["disseminated_detected"] == 2
+        # steady state: both engines end with every live node holding a
+        # DEAD view of both crashed nodes and nothing else
+        live = n - 2
+        assert int(np.asarray(res_r.series.dead_views)[-1]) == 2 * live
+        assert int(np.asarray(res_d.series.dead_views)[-1]) == 2 * live
+        assert int(np.asarray(res_r.series.false_dead_views).max()) == 0
+        assert int(np.asarray(res_d.series.false_dead_views).max()) == 0

@@ -1,0 +1,87 @@
+"""Wait for the axon TPU tunnel to recover, then capture the round's
+TPU artifacts: full bench (all tiers) and the 1M-node studies.
+
+Results land in bench_results/ as JSON; each capture is atomic and the
+script exits after one successful full capture (or after --max-hours).
+
+Usage: python scripts/tpu_watch.py [--max-hours H]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "bench_results")
+
+
+def probe(timeout: float = 120.0) -> bool:
+    code = "import jax; d=jax.devices(); print(d[0].platform, len(d))"
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+        return r.returncode == 0 and "tpu" in r.stdout
+    except subprocess.SubprocessError:
+        return False
+
+
+def run_save(name: str, cmd: list[str], timeout: float) -> bool:
+    print(f"[tpu_watch] running {name}: {' '.join(cmd)}", flush=True)
+    try:
+        r = subprocess.run(cmd, timeout=timeout, capture_output=True,
+                           text=True, cwd=REPO)
+    except subprocess.SubprocessError as e:
+        print(f"[tpu_watch] {name} failed: {e}", flush=True)
+        return False
+    os.makedirs(OUT, exist_ok=True)
+    payload = None
+    for line in reversed((r.stdout or "").strip().splitlines()):
+        try:
+            payload = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    with open(os.path.join(OUT, f"{name}.json"), "w") as f:
+        json.dump({"cmd": cmd, "rc": r.returncode, "result": payload,
+                   "stderr_tail": (r.stderr or "")[-2000:],
+                   "captured_at": time.strftime("%Y-%m-%d %H:%M:%S")},
+                  f, indent=1)
+    print(f"[tpu_watch] {name}: rc={r.returncode} "
+          f"parsed={'yes' if payload else 'no'}", flush=True)
+    return r.returncode == 0 and payload is not None
+
+
+def main() -> int:
+    max_hours = 12.0
+    if "--max-hours" in sys.argv:
+        max_hours = float(sys.argv[sys.argv.index("--max-hours") + 1])
+    deadline = time.time() + max_hours * 3600
+    while time.time() < deadline:
+        if probe():
+            print("[tpu_watch] TPU healthy — capturing", flush=True)
+            ok = run_save("bench_all",
+                          [sys.executable, "bench.py", "--tier", "all"],
+                          3600)
+            run_save("study_suspicion_1m", [
+                sys.executable, "-m", "swim_tpu.cli", "study",
+                "suspicion_sweep", "--nodes", "1000000", "--engine",
+                "ring", "--periods", "100", "--mults", "3.0", "5.0"],
+                3600)
+            run_save("study_lifeguard_1m", [
+                sys.executable, "-m", "swim_tpu.cli", "study",
+                "lifeguard", "--nodes", "1000000", "--engine", "ring",
+                "--periods", "100"], 3600)
+            if ok:
+                print("[tpu_watch] capture complete", flush=True)
+                return 0
+            print("[tpu_watch] bench incomplete; will retry", flush=True)
+        time.sleep(240)
+    print("[tpu_watch] gave up (deadline)", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

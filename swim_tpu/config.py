@@ -64,16 +64,32 @@ class SwimConfig:
     # --- engine capacity knobs (rumor engine only) ---
     rumor_capacity: int = 0      # 0 → sized automatically from n_nodes
     sentinels: int = 4           # independent suspectors tracked per rumor
-    # --- ring engine geometry (swim_tpu/models/ring.py) ---
+    # --- ring engine geometry + probe pattern (swim_tpu/models/ring.py) ---
     ring_orig_words: int = 2     # OW: 32-slot words originated per period
     ring_window_periods: int = 6  # window = OW * this many words
     ring_view_c: int = 3         # per-subject top-C view index depth
+    ring_probe: str = "rotor"    # "rotor": shared-offset round-robin (all
+    #                              waves are rolls; fastest; SWIM §4.3
+    #                              bounded-detection regime). "pull":
+    #                              pull-sampled uniform probing — preserves
+    #                              the paper's geometric e/(e−1) first-
+    #                              detection law exactly (gather-based
+    #                              delivery; vanilla protocol only).
 
     def __post_init__(self):
         if self.n_nodes < 2:
             raise ValueError("SWIM needs at least 2 nodes")
         if self.target_selection not in ("uniform", "round_robin"):
             raise ValueError(f"bad target_selection {self.target_selection!r}")
+        if self.ring_probe not in ("rotor", "pull"):
+            raise ValueError(f"bad ring_probe {self.ring_probe!r}")
+        if self.ring_probe == "pull" and self.lifeguard:
+            raise ValueError(
+                "ring_probe='pull' supports the vanilla protocol only: "
+                "probe outcomes live on the probed node's lanes, so the "
+                "prober-side Lifeguard health accounting (LHA) cannot be "
+                "tracked without scatters — use rotor mode or the rumor/"
+                "dense engines for Lifeguard studies")
 
     # -- derived constants (plain Python: evaluated at trace time) ----------
 

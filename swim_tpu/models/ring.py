@@ -47,13 +47,17 @@ handful of fused streaming passes over ~50 MB of hot state at N=1M:
 Protocol semantics are the rumor engine's (docs/PROTOCOL.md §3–§7 and
 its documented deviations) with these additional documented deviations:
 
-  R1. **Rotor probing.**  Shared-offset round-robin instead of per-node
-      shuffled lists: the §4.3 bounded-detection regime, not uniform
-      sampling — the e/(e−1) geometric law of the uniform mode does not
-      apply (a crash is detected in ≤ ~2 periods).  Proxy offsets may
-      coincide with each other / the target / self with probability
-      O(k/N); such a proxy slot is wasted (exact SWIM samples proxies
-      without replacement).
+  R1. **Rotor probing** (default, `cfg.ring_probe == "rotor"`).
+      Shared-offset round-robin instead of per-node shuffled lists: the
+      §4.3 bounded-detection regime, not uniform sampling — a crash is
+      detected in ≤ ~2 periods.  Proxy offsets may coincide with each
+      other / the target / self with probability O(k/N); such a proxy
+      slot is wasted (exact SWIM samples proxies without replacement).
+      `cfg.ring_probe == "pull"` instead samples each node's IN-probe
+      lane (deviations P1–P4 at the pull branch below), preserving
+      uniform probing's geometric e/(e−1) first-detection law exactly,
+      still scatter-free (delivery by row gathers); vanilla protocol
+      only.
   R2. **Burst transmissibility.**  A rumor gossips while its word is in
       the window (WW/OW periods per burst), recycling while it spreads,
       up to `2 * gossip_window` periods total; eviction of a
@@ -178,16 +182,67 @@ def init_state(cfg: SwimConfig) -> RingState:
 # ---------------------------------------------------------------------------
 
 
+PULL_SRC_ATTEMPTS = 3
+
+
+def pow_f32(base, expo):
+    """base**expo for f32 base and non-negative i32 expo, by 31 rounds of
+    square-and-multiply in a FIXED operation order.  IEEE-754 f32 multiply
+    and divide are correctly rounded, so evaluating the identical
+    operation sequence in jnp (engine) and numpy (oracle) yields
+    bit-identical results on every backend — which is what lets the
+    pull-mode probed decision stay part of the bitwise contract."""
+    one = jnp.float32(1.0)
+    result = jnp.broadcast_to(one, jnp.shape(expo)).astype(jnp.float32)
+    cur = jnp.broadcast_to(jnp.asarray(base, jnp.float32),
+                           jnp.shape(expo)).astype(jnp.float32)
+    e = jnp.asarray(expo, jnp.int32)
+    for bit in range(31):
+        result = jnp.where((e >> bit) & 1 == 1, result * cur, result)
+        cur = cur * cur
+    return result
+
+
+def py_pow_f32(base: float, expo: int) -> float:
+    """Scalar numpy twin of pow_f32 (same operation order, f32 ops)."""
+    import numpy as np
+
+    result = np.float32(1.0)
+    cur = np.float32(base)
+    e = int(expo)
+    for bit in range(31):
+        if (e >> bit) & 1:
+            result = np.float32(result * cur)
+        cur = np.float32(cur * cur)
+    return float(result)
+
+
+class PullRandomness(NamedTuple):
+    """Per-period uniforms for the pull-uniform probe mode (one pulled
+    prober lane per node — see `step`'s pull branch for semantics)."""
+
+    m_u: jax.Array      # f32[N]  in-probe count draw (vs exact P(m=0))
+    src_u: jax.Array    # f32[N, A]  prober-id draws (first-alive wins)
+    d_fwd: jax.Array    # f32[N]  direct ping leg
+    d_back: jax.Array   # f32[N]  direct ack leg
+    px_u: jax.Array     # f32[N, k]  proxy-id draws
+    px_fwd: jax.Array   # f32[N, k]  ping-req + proxy-ping legs (composed)
+    px_back: jax.Array  # f32[N, k]  proxy-ack + relay legs (composed)
+    ack_u: jax.Array    # f32[N]  ack-gossip contact draw (P3')
+    ack_leg: jax.Array  # f32[N]  its composed ping+ack legs
+
+
 class RingRandomness(NamedTuple):
-    s_off: jax.Array    # i32 scalar: probe offset in [1, N)
-    q_off: jax.Array    # i32[k]:  proxy offsets in [1, N)
-    loss_w1: jax.Array  # f32[N]
-    loss_w2: jax.Array  # f32[N]
-    loss_w3: jax.Array  # f32[N, k]
-    loss_w4: jax.Array  # f32[N, k]
-    loss_w5: jax.Array  # f32[N, k]
-    loss_w6: jax.Array  # f32[N, k]
-    lha_u: jax.Array    # f32[N]  Lifeguard probe-thinning uniform
+    s_off: jax.Array    # i32 scalar: probe offset in [1, N)   (rotor)
+    q_off: jax.Array    # i32[k]:  proxy offsets in [1, N)     (rotor)
+    loss_w1: jax.Array  # f32[N]                               (rotor)
+    loss_w2: jax.Array  # f32[N]                               (rotor)
+    loss_w3: jax.Array  # f32[N, k]                            (rotor)
+    loss_w4: jax.Array  # f32[N, k]                            (rotor)
+    loss_w5: jax.Array  # f32[N, k]                            (rotor)
+    loss_w6: jax.Array  # f32[N, k]                            (rotor)
+    lha_u: jax.Array    # f32[N]  Lifeguard probe thinning     (rotor)
+    pull: PullRandomness | None = None          # pull mode only
 
 
 def draw_period_ring(key: jax.Array, step, cfg: SwimConfig) -> RingRandomness:
@@ -208,6 +263,24 @@ def draw_period_ring(key: jax.Array, step, cfg: SwimConfig) -> RingRandomness:
     q_off = sampling.feistel(jnp.arange(k, dtype=jnp.uint32), n - 1,
                              pka, pkb) + 1
     kk = jax.random.fold_in(key, step)
+    if cfg.ring_probe == "pull":
+        ks = jax.random.split(kk, 9)
+        zero = jnp.zeros((0,), jnp.float32)
+        return RingRandomness(
+            s_off=s_off.astype(jnp.int32), q_off=q_off.astype(jnp.int32),
+            loss_w1=zero, loss_w2=zero, loss_w3=zero, loss_w4=zero,
+            loss_w5=zero, loss_w6=zero, lha_u=zero,
+            pull=PullRandomness(
+                m_u=jax.random.uniform(ks[0], (n,)),
+                src_u=jax.random.uniform(ks[1], (n, PULL_SRC_ATTEMPTS)),
+                d_fwd=jax.random.uniform(ks[2], (n,)),
+                d_back=jax.random.uniform(ks[3], (n,)),
+                px_u=jax.random.uniform(ks[4], (n, k)),
+                px_fwd=jax.random.uniform(ks[5], (n, k)),
+                px_back=jax.random.uniform(ks[6], (n, k)),
+                ack_u=jax.random.uniform(ks[7], (n,)),
+                ack_leg=jax.random.uniform(ks[8], (n,)),
+            ))
     ks = jax.random.split(kk, 7)
     return RingRandomness(
         s_off=s_off.astype(jnp.int32),
@@ -434,19 +507,9 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                 best, jnp.where(kn, top_key[lvl][subj], jnp.uint32(0)))
         return best
 
-    # ---- Phase A: rotor offsets -------------------------------------------
-    s_off = rnd.s_off
-    target = jnp.mod(ids + s_off, n)
-    # a not-yet-joined target is in nobody's membership list: idle period
-    prober = active & joined[target]
+    # ---- Phases A+B+probe-verdicts, per probe pattern ---------------------
     pid = plan.partition_id
     loss_f = plan.loss.astype(jnp.float32)
-
-    def roll_from(x, d):
-        """Value of x at node (i + d) mod n, for each i (d traced)."""
-        return jnp.roll(x, -d, axis=0)
-
-    # ---- Phase B: six waves, all rolls ------------------------------------
     b_pig = min(cfg.max_piggyback, g.ww * WORD)
     win_slots_lin = jnp.mod(win_ring0 * WORD
                             + jnp.arange(g.ww * WORD, dtype=jnp.int32),
@@ -456,90 +519,193 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         elig, jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)[None, :],
         jnp.uint32(0)), axis=1)                                # u32[WW]
 
-    def buddy_bits(subj):
-        """u32[N, WW]: forced window bit of the suspect witness about
-        subj[i], when sender i knows it and it lies in the window."""
-        if not (cfg.lifeguard and cfg.buddy):
-            return jnp.zeros((n, g.ww), jnp.uint32)
-        slot = sus_slot[subj]
-        kn = knows_bit(ids, slot)
-        in_win, wcol, _, bit = slot_pos(slot)
-        usebit = kn & in_win
-        onehot_w = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]
-                    == wcol[:, None])
-        return jnp.where(usebit[:, None] & onehot_w,
-                         (jnp.uint32(1) << bit)[:, None], jnp.uint32(0))
-
     def sel_now(forced):
         return _select_first_b(win & elig_mask[None, :], b_pig) | forced
 
-    def wave_ok(send_flag_at_sender, d, u):
-        """bool[N] per receiver i: the message from node (i+d) arrived."""
-        return (roll_from(send_flag_at_sender, d) & active
-                & ~(part_on & (roll_from(pid, d) != pid))
-                & (u >= loss_f))
-
-    # W1: ping i -> i+s.  Receiver j hears from sender j−s.
-    sel1 = sel_now(buddy_bits(target))
-    ok1 = wave_ok(prober & active, -s_off, rnd.loss_w1)   # per receiver j
-    win = win | jnp.where(ok1[:, None], roll_from(sel1, -s_off),
-                          jnp.uint32(0))
-    # W2: ack j=i+s -> i.  The ack sender is j (acks iff the ping arrived:
-    # ok1 is indexed by j already).  Receiver i hears from i+s.
-    sel2 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
-    ok2 = wave_ok(ok1, s_off, rnd.loss_w2)                # per receiver i
-    win = win | jnp.where(ok2[:, None], roll_from(sel2, s_off),
-                          jnp.uint32(0))
-    acked = ok2 & prober
-
-    need = prober & ~acked
-    relayed = jnp.zeros((n,), jnp.bool_)
-    for a in range(k):
-        q = rnd.q_off[a]
-        d4 = s_off - q
-        # W3: ping-req i -> i+q.  Receiver p hears from p−q.
-        sel3 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
-        ok3 = wave_ok(need, -q, rnd.loss_w3[:, a])        # per receiver p
-        win = win | jnp.where(ok3[:, None], roll_from(sel3, -q),
-                              jnp.uint32(0))
-        # W4: proxy ping p -> p+d4 (the original target j=i+s).  Receiver
-        # j hears from j−d4 = p.
-        sel4 = sel_now(buddy_bits(jnp.mod(ids + d4, n)))
-        ok4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a])        # per receiver j
-        win = win | jnp.where(ok4[:, None], roll_from(sel4, -d4),
-                              jnp.uint32(0))
-        # W5: target ack j -> j−d4 (back to proxy p).  Receiver p hears
-        # from p+d4.
-        sel5 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
-        ok5 = wave_ok(ok4, d4, rnd.loss_w5[:, a])         # per receiver p
-        win = win | jnp.where(ok5[:, None], roll_from(sel5, d4),
-                              jnp.uint32(0))
-        # W6: relay ack p -> p−q (back to prober i).  Receiver i hears
-        # from i+q.
-        sel6 = sel_now(jnp.zeros((n, g.ww), jnp.uint32))
-        ok6 = wave_ok(ok5, q, rnd.loss_w6[:, a])          # per receiver i
-        win = win | jnp.where(ok6[:, None], roll_from(sel6, q),
-                              jnp.uint32(0))
-        relayed = relayed | (ok6 & need)
-
-    # ---- Phase C: verdicts ------------------------------------------------
-    probe_ok = acked | relayed
-    failed = prober & ~probe_ok
+    no_force = jnp.zeros((n, g.ww), jnp.uint32)
     lha = state.lha
-    s_probe = lha
-    if cfg.lifeguard:
-        lha = jnp.where(prober,
-                        jnp.clip(lha + jnp.where(failed, 1, -1), 0,
-                                 cfg.lha_max), lha)
-        thin = rnd.lha_u < (jnp.float32(1.0)
-                            / (1 + s_probe).astype(jnp.float32))
-        failed = failed & thin
-    viewed_tk = view_of(ids, target)
+
+    if cfg.ring_probe == "rotor":
+        # Rotor: target(i) = i + s_t; every wave is a roll (deviation R1).
+        s_off = rnd.s_off
+        target = jnp.mod(ids + s_off, n)
+        # a not-yet-joined target is in nobody's membership list: idle
+        prober = active & joined[target]
+
+        def roll_from(x, d):
+            """Value of x at node (i + d) mod n, for each i (d traced)."""
+            return jnp.roll(x, -d, axis=0)
+
+        def buddy_bits(subj):
+            """u32[N, WW]: forced window bit of the suspect witness about
+            subj[i], when sender i knows it and it is in the window."""
+            if not (cfg.lifeguard and cfg.buddy):
+                return no_force
+            slot = sus_slot[subj]
+            kn = knows_bit(ids, slot)
+            in_win, wcol, _, bit = slot_pos(slot)
+            usebit = kn & in_win
+            onehot_w = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]
+                        == wcol[:, None])
+            return jnp.where(usebit[:, None] & onehot_w,
+                             (jnp.uint32(1) << bit)[:, None], jnp.uint32(0))
+
+        def wave_ok(send_flag_at_sender, d, u):
+            """bool[N] per receiver i: the message from (i+d) arrived."""
+            return (roll_from(send_flag_at_sender, d) & active
+                    & ~(part_on & (roll_from(pid, d) != pid))
+                    & (u >= loss_f))
+
+        # W1: ping i -> i+s.  Receiver j hears from sender j−s.
+        sel1 = sel_now(buddy_bits(target))
+        ok1 = wave_ok(prober & active, -s_off, rnd.loss_w1)  # per recv j
+        win = win | jnp.where(ok1[:, None], roll_from(sel1, -s_off),
+                              jnp.uint32(0))
+        # W2: ack j=i+s -> i (acks iff the ping arrived; ok1 is indexed
+        # by j already).  Receiver i hears from i+s.
+        sel2 = sel_now(no_force)
+        ok2 = wave_ok(ok1, s_off, rnd.loss_w2)               # per recv i
+        win = win | jnp.where(ok2[:, None], roll_from(sel2, s_off),
+                              jnp.uint32(0))
+        acked = ok2 & prober
+
+        need = prober & ~acked
+        relayed = jnp.zeros((n,), jnp.bool_)
+        for a in range(k):
+            q = rnd.q_off[a]
+            d4 = s_off - q
+            # W3: ping-req i -> i+q.  Receiver p hears from p−q.
+            sel3 = sel_now(no_force)
+            ok3 = wave_ok(need, -q, rnd.loss_w3[:, a])       # per recv p
+            win = win | jnp.where(ok3[:, None], roll_from(sel3, -q),
+                                  jnp.uint32(0))
+            # W4: proxy ping p -> p+d4 (the original target j=i+s).
+            # Receiver j hears from j−d4 = p.
+            sel4 = sel_now(buddy_bits(jnp.mod(ids + d4, n)))
+            ok4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a])       # per recv j
+            win = win | jnp.where(ok4[:, None], roll_from(sel4, -d4),
+                                  jnp.uint32(0))
+            # W5: target ack j -> j−d4 (back to proxy p).  Receiver p
+            # hears from p+d4.
+            sel5 = sel_now(no_force)
+            ok5 = wave_ok(ok4, d4, rnd.loss_w5[:, a])        # per recv p
+            win = win | jnp.where(ok5[:, None], roll_from(sel5, d4),
+                                  jnp.uint32(0))
+            # W6: relay ack p -> p−q (back to prober i).  Receiver i
+            # hears from i+q.
+            sel6 = sel_now(no_force)
+            ok6 = wave_ok(ok5, q, rnd.loss_w6[:, a])         # per recv i
+            win = win | jnp.where(ok6[:, None], roll_from(sel6, q),
+                                  jnp.uint32(0))
+            relayed = relayed | (ok6 & need)
+
+        probe_ok = acked | relayed
+        failed = prober & ~probe_ok
+        s_probe = lha
+        if cfg.lifeguard:
+            lha = jnp.where(prober,
+                            jnp.clip(lha + jnp.where(failed, 1, -1), 0,
+                                     cfg.lha_max), lha)
+            thin = rnd.lha_u < (jnp.float32(1.0)
+                                / (1 + s_probe).astype(jnp.float32))
+            failed = failed & thin
+        viewed_tk = view_of(ids, target)
+        susp_subject = target
+        susp_orig = ids
+    else:
+        # Pull-uniform (cfg.ring_probe == "pull"): each node j samples its
+        # own IN-probe lane from the environment side, preserving uniform
+        # probing's first-detection law with gather-only delivery.
+        # Documented deviations (vs exact uniform SWIM):
+        #   P1. One prober lane per node per period, fired with the EXACT
+        #       no-probe probability P(m_j=0) = (1 − 1/(M−1))^{L_j} of the
+        #       push model (M = joined members, L_j = live members other
+        #       than j) — so the geometric first-detection law holds
+        #       exactly, join churn included; periods where several nodes
+        #       probed j are folded into one prober.
+        #   P2. The prober id is the first live draw of A=3 uniforms over
+        #       the other ids (all-dead draws: lane idles — pessimistic);
+        #       a proxy may coincide with the prober/target.
+        #   P3. Gossip flows only TOWARD a node (the direct ping plus the
+        #       first successful proxy ping deliver piggyback); the
+        #       ack-direction gossip of exact SWIM (each prober hears its
+        #       target's piggyback) is modeled by one "ack-pull" contact
+        #       per node from an INDEPENDENT uniform draw at the composed
+        #       ping+ack delivery probability — same marginal flow, but
+        #       the draw is decoupled from the node's simulated out-probe.
+        #   P4. Each two-hop message path composes its two loss legs into
+        #       one draw against (1−loss)²  (same marginal probability).
+        pr = rnd.pull
+        sel_all = sel_now(no_force)
+        # P(m_j = 0) = (1 − 1/(M−1))^{L_j}: a live prober picks uniformly
+        # among the M−1 OTHER JOINED members (membership-list semantics,
+        # join-churn aware), and there are L_j live probers besides j.
+        members = jnp.sum(joined).astype(jnp.int32)
+        lj = live_total - active.astype(jnp.int32)
+        denom = jnp.maximum(members - 1, 1).astype(jnp.float32)
+        base = jnp.float32(1.0) - jnp.float32(1.0) / denom
+        p0 = jnp.where(members >= 2, pow_f32(base, jnp.maximum(lj, 0)),
+                       jnp.float32(1.0))
+        probed = (pr.m_u >= p0) & joined          # only members are probed
+
+        def draw_id(u):
+            idx = (u * jnp.float32(n - 1)).astype(jnp.int32)
+            idx = jnp.minimum(idx, n - 2)
+            return idx + (idx >= ids).astype(jnp.int32)
+
+        src = draw_id(pr.src_u[:, 0])
+        src_ok = active[src]
+        for a in range(1, PULL_SRC_ATTEMPTS):
+            nxt = draw_id(pr.src_u[:, a])
+            src = jnp.where(src_ok, src, nxt)
+            src_ok = src_ok | active[nxt]
+        probe_live = probed & src_ok
+
+        def part_cut(a_ids, b_ids):
+            return part_on & (pid[a_ids] != pid[b_ids])
+
+        thr2 = 1.0 - (1.0 - loss_f) * (1.0 - loss_f)
+        # direct ping src -> j and its ack
+        d_fwd_ok = (probe_live & active & ~part_cut(src, ids)
+                    & (pr.d_fwd >= loss_f))
+        win = win | jnp.where(d_fwd_ok[:, None], sel_all[src],
+                              jnp.uint32(0))
+        acked_lane = d_fwd_ok & (pr.d_back >= loss_f)
+        # indirect: k proxies, two-hop paths with composed legs (P4)
+        need = probe_live & ~acked_lane
+        relayed_lane = jnp.zeros((n,), jnp.bool_)
+        px_deliver = jnp.zeros((n,), jnp.bool_)
+        px_src = jnp.zeros((n,), jnp.int32)
+        for b in range(k):
+            p_b = draw_id(pr.px_u[:, b])
+            path_up = need & active[p_b] & ~part_cut(src, p_b) \
+                & ~part_cut(p_b, ids)
+            w4_ok = path_up & active & (pr.px_fwd[:, b] >= thr2)
+            first = w4_ok & ~px_deliver
+            px_src = jnp.where(first, p_b, px_src)
+            px_deliver = px_deliver | w4_ok
+            relayed_lane = relayed_lane | (
+                w4_ok & (pr.px_back[:, b] >= thr2))
+        win = win | jnp.where(px_deliver[:, None], sel_all[px_src],
+                              jnp.uint32(0))
+        # ack-direction gossip (P3'): one contact from an independent
+        # uniform draw, delivered iff a ping+ack round trip would be
+        aq = draw_id(pr.ack_u)
+        ack_gossip_ok = (active & active[aq] & ~part_cut(ids, aq)
+                         & (pr.ack_leg >= thr2))
+        win = win | jnp.where(ack_gossip_ok[:, None], sel_all[aq],
+                              jnp.uint32(0))
+        failed = probe_live & ~(acked_lane | relayed_lane)
+        viewed_tk = view_of(src, ids)             # src's view of j
+        susp_subject = ids
+        susp_orig = src
+
     v_status = lattice.status_of(viewed_tk)
     mk_suspect = failed & (v_status == 0)
     re_suspect = failed & (v_status == 1)
     susp_key = lattice.suspect_key(lattice.incarnation_of(viewed_tk))
 
+    # ---- Phase C: refutation + sentinel expiry ----------------------------
     # refutation: i knows a suspect rumor about i outranking its aliveness
     self_key = jnp.where(knows_bit(ids, sus_slot[ids]), sus_bk[ids],
                          jnp.uint32(0))
@@ -582,10 +748,11 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # ---- Phase D: new originations into the free fresh lanes --------------
     # Channels, priority order: confirms > refutes > new/independent
     # suspicions (carried lanes were already placed in Phase 0).
-    c_subj = jnp.concatenate([subject, ids, target])
+    c_subj = jnp.concatenate([subject, ids, susp_subject])
     c_key = jnp.concatenate([dead_key_r, lattice.alive_key(new_inc),
                              susp_key])
-    c_orig = jnp.concatenate([jnp.maximum(conf_node, 0), ids, ids])
+    c_orig = jnp.concatenate([jnp.maximum(conf_node, 0), ids,
+                              susp_orig])
     c_valid = jnp.concatenate([confirm, refute, mk_suspect | re_suspect])
     c_srcslot = jnp.concatenate([rr, jnp.full((2 * n,), -1, jnp.int32)])
     c_is_susp = jnp.concatenate([jnp.zeros((r_tot + n,), jnp.bool_),

@@ -249,108 +249,207 @@ class RingOracle:
             for sl in select_b(src) + extra:
                 st.knows[dst, sl] = True
 
-        # W1 + W2 (selection state mutates between waves, so evaluate all
-        # of a wave's selections BEFORE any of its deliveries)
-        tgt = [(i + s_off) % n for i in range(n)]
-        # a not-yet-joined target is in nobody's membership list: no probe
-        prober_mask = active & joined[np.asarray(tgt)]
-        w1_payload = {}
-        for i in range(n):
-            if prober_mask[i]:
-                w1_payload[i] = select_b(i) + buddy(i, tgt[i])
-        ok1 = np.zeros(n, bool)                   # indexed by receiver j
-        for j in range(n):
-            i = (j - s_off) % n
-            if i in w1_payload and delivered(i, j, float(u["loss_w1"][j])):
-                ok1[j] = True
-        for j in np.nonzero(ok1)[0]:
-            for sl in w1_payload[(j - s_off) % n]:
-                st.knows[j, sl] = True
-
-        w2_payload = {}
-        for j in np.nonzero(ok1)[0]:
-            w2_payload[int(j)] = select_b(int(j))
-        ok2 = np.zeros(n, bool)                   # indexed by receiver i
-        for i in range(n):
-            j = (i + s_off) % n
-            if j in w2_payload and delivered(j, i, float(u["loss_w2"][i])):
-                ok2[i] = True
-        for i in np.nonzero(ok2)[0]:
-            for sl in w2_payload[(i + s_off) % n]:
-                st.knows[i, sl] = True
-        acked = ok2 & prober_mask
-
-        need = prober_mask & ~acked
-        relayed = np.zeros(n, bool)
-        for a in range(k):
-            q = q_off[a]
-            d4 = s_off - q
-            # W3
-            p3 = {i: select_b(i) for i in range(n) if need[i]}
-            ok3 = np.zeros(n, bool)               # by receiver p
-            for p in range(n):
-                i = (p - q) % n
-                if i in p3 and delivered(i, p, float(u["loss_w3"][p, a])):
-                    ok3[p] = True
-            for p in np.nonzero(ok3)[0]:
-                for sl in p3[(p - q) % n]:
-                    st.knows[p, sl] = True
-            # W4
-            p4 = {}
-            for p in np.nonzero(ok3)[0]:
-                jj = (p + d4) % n
-                p4[int(p)] = select_b(int(p)) + buddy(int(p), jj)
-            ok4 = np.zeros(n, bool)               # by receiver j
-            for j in range(n):
-                p = (j - d4) % n
-                if p in p4 and delivered(p, j, float(u["loss_w4"][j, a])):
-                    ok4[j] = True
-            for j in np.nonzero(ok4)[0]:
-                for sl in p4[(j - d4) % n]:
-                    st.knows[j, sl] = True
-            # W5
-            p5 = {int(j): select_b(int(j)) for j in np.nonzero(ok4)[0]}
-            ok5 = np.zeros(n, bool)               # by receiver p
-            for p in range(n):
-                j = (p + d4) % n
-                if j in p5 and delivered(j, p, float(u["loss_w5"][p, a])):
-                    ok5[p] = True
-            for p in np.nonzero(ok5)[0]:
-                for sl in p5[(p + d4) % n]:
-                    st.knows[p, sl] = True
-            # W6
-            p6 = {int(p): select_b(int(p)) for p in np.nonzero(ok5)[0]}
-            ok6 = np.zeros(n, bool)               # by receiver i
-            for i in range(n):
-                p = (i + q) % n
-                if p in p6 and delivered(p, i, float(u["loss_w6"][i, a])):
-                    ok6[i] = True
-            for i in np.nonzero(ok6)[0]:
-                for sl in p6[(i + q) % n]:
-                    st.knows[i, sl] = True
-            relayed |= ok6 & need
-
-        # --- Phase C: verdicts ---------------------------------------------
-        probe_ok = acked | relayed
-        failed = prober_mask & ~probe_ok
         lha = st.lha.copy()
-        s_probe = st.lha.copy()
-        if cfg.lifeguard:
+        if cfg.ring_probe == "rotor":
+            # W1 + W2 (selection state mutates between waves, so evaluate
+            # all of a wave's selections BEFORE any of its deliveries)
+            tgt = [(i + s_off) % n for i in range(n)]
+            # a not-yet-joined target: in nobody's membership list
+            prober_mask = active & joined[np.asarray(tgt)]
+            w1_payload = {}
             for i in range(n):
-                if active[i]:
-                    lha[i] = min(max(lha[i] + (1 if failed[i] else -1), 0),
-                                 cfg.lha_max)
+                if prober_mask[i]:
+                    w1_payload[i] = select_b(i) + buddy(i, tgt[i])
+            ok1 = np.zeros(n, bool)               # indexed by receiver j
+            for j in range(n):
+                i = (j - s_off) % n
+                if i in w1_payload and delivered(i, j,
+                                                 float(u["loss_w1"][j])):
+                    ok1[j] = True
+            for j in np.nonzero(ok1)[0]:
+                for sl in w1_payload[(j - s_off) % n]:
+                    st.knows[j, sl] = True
+
+            w2_payload = {}
+            for j in np.nonzero(ok1)[0]:
+                w2_payload[int(j)] = select_b(int(j))
+            ok2 = np.zeros(n, bool)               # indexed by receiver i
             for i in range(n):
-                if failed[i] and not (float(u["lha_u"][i])
-                                      < 1.0 / (1 + int(s_probe[i]))):
-                    failed[i] = False
+                j = (i + s_off) % n
+                if j in w2_payload and delivered(j, i,
+                                                 float(u["loss_w2"][i])):
+                    ok2[i] = True
+            for i in np.nonzero(ok2)[0]:
+                for sl in w2_payload[(i + s_off) % n]:
+                    st.knows[i, sl] = True
+            acked = ok2 & prober_mask
+
+            need = prober_mask & ~acked
+            relayed = np.zeros(n, bool)
+            for a in range(k):
+                q = q_off[a]
+                d4 = s_off - q
+                # W3
+                p3 = {i: select_b(i) for i in range(n) if need[i]}
+                ok3 = np.zeros(n, bool)           # by receiver p
+                for p in range(n):
+                    i = (p - q) % n
+                    if i in p3 and delivered(i, p,
+                                             float(u["loss_w3"][p, a])):
+                        ok3[p] = True
+                for p in np.nonzero(ok3)[0]:
+                    for sl in p3[(p - q) % n]:
+                        st.knows[p, sl] = True
+                # W4
+                p4 = {}
+                for p in np.nonzero(ok3)[0]:
+                    jj = (p + d4) % n
+                    p4[int(p)] = select_b(int(p)) + buddy(int(p), jj)
+                ok4 = np.zeros(n, bool)           # by receiver j
+                for j in range(n):
+                    p = (j - d4) % n
+                    if p in p4 and delivered(p, j,
+                                             float(u["loss_w4"][j, a])):
+                        ok4[j] = True
+                for j in np.nonzero(ok4)[0]:
+                    for sl in p4[(j - d4) % n]:
+                        st.knows[j, sl] = True
+                # W5
+                p5 = {int(j): select_b(int(j))
+                      for j in np.nonzero(ok4)[0]}
+                ok5 = np.zeros(n, bool)           # by receiver p
+                for p in range(n):
+                    j = (p + d4) % n
+                    if j in p5 and delivered(j, p,
+                                             float(u["loss_w5"][p, a])):
+                        ok5[p] = True
+                for p in np.nonzero(ok5)[0]:
+                    for sl in p5[(p + d4) % n]:
+                        st.knows[p, sl] = True
+                # W6
+                p6 = {int(p): select_b(int(p))
+                      for p in np.nonzero(ok5)[0]}
+                ok6 = np.zeros(n, bool)           # by receiver i
+                for i in range(n):
+                    p = (i + q) % n
+                    if p in p6 and delivered(p, i,
+                                             float(u["loss_w6"][i, a])):
+                        ok6[i] = True
+                for i in np.nonzero(ok6)[0]:
+                    for sl in p6[(i + q) % n]:
+                        st.knows[i, sl] = True
+                relayed |= ok6 & need
+
+            probe_ok = acked | relayed
+            failed = prober_mask & ~probe_ok
+            s_probe = st.lha.copy()
+            if cfg.lifeguard:
+                for i in range(n):
+                    if prober_mask[i]:    # idle periods leave LHA alone
+                        lha[i] = min(max(lha[i] + (1 if failed[i] else -1),
+                                         0), cfg.lha_max)
+                for i in range(n):
+                    if failed[i] and not (float(u["lha_u"][i])
+                                          < 1.0 / (1 + int(s_probe[i]))):
+                        failed[i] = False
+            susp_sub = list(tgt)
+            susp_org = list(range(n))
+            view_rows = list(range(n))
+        else:
+            # pull-uniform mode: mirror of ring.py's pull branch
+            # (deviations P1-P4 there), same operation order: all
+            # selections precomputed, all deliveries applied, THEN views.
+            from swim_tpu.models.ring import PULL_SRC_ATTEMPTS, py_pow_f32
+
+            pr = rnd.pull
+            m_u = np.asarray(pr.m_u)
+            src_u = np.asarray(pr.src_u)
+            d_fwd = np.asarray(pr.d_fwd)
+            d_back = np.asarray(pr.d_back)
+            px_u = np.asarray(pr.px_u)
+            px_fwd = np.asarray(pr.px_fwd)
+            px_back = np.asarray(pr.px_back)
+            ack_u = np.asarray(pr.ack_u)
+            ack_leg = np.asarray(pr.ack_leg)
+            members_i = int(joined.sum())
+            denom = np.float32(max(members_i - 1, 1))
+            base0 = float(np.float32(np.float32(1.0)
+                                     - np.float32(1.0) / denom))
+            lf = np.float32(loss)
+            thr2 = np.float32(1.0) - (np.float32(1.0) - lf) * (
+                np.float32(1.0) - lf)
+            sel_cache = {i: select_b(i) for i in range(n)}
+            live_total_i = int(active.sum())
+
+            def draw_id(j: int, uu) -> int:
+                idx = int(np.float32(uu) * np.float32(n - 1))
+                idx = min(idx, n - 2)
+                return idx + (1 if idx >= j else 0)
+
+            def cut(a_id: int, b_id: int) -> bool:
+                return part_on and pid[a_id] != pid[b_id]
+
+            failed = np.zeros(n, bool)
+            src_arr = np.zeros(n, np.int32)
+            deliveries: list[tuple[int, int]] = []   # (dst, sender)
+            for j in range(n):
+                ljj = live_total_i - (1 if active[j] else 0)
+                if members_i >= 2:
+                    p0j = np.float32(py_pow_f32(base0, max(ljj, 0)))
+                else:
+                    p0j = np.float32(1.0)
+                probed = (np.float32(m_u[j]) >= p0j) and joined[j]
+                src = draw_id(j, src_u[j, 0])
+                src_ok = bool(active[src])
+                for a in range(1, PULL_SRC_ATTEMPTS):
+                    nxt = draw_id(j, src_u[j, a])
+                    if not src_ok:
+                        src = nxt
+                    src_ok = src_ok or bool(active[nxt])
+                src_arr[j] = src
+                probe_live = probed and src_ok
+                d_ok = (probe_live and active[j] and not cut(src, j)
+                        and np.float32(d_fwd[j]) >= lf)
+                if d_ok:
+                    deliveries.append((j, src))
+                acked_lane = d_ok and np.float32(d_back[j]) >= lf
+                need = probe_live and not acked_lane
+                relayed_lane = False
+                px_deliver = False
+                px_src = 0
+                for b in range(k):
+                    p_b = draw_id(j, px_u[j, b])
+                    path_up = (need and active[p_b] and not cut(src, p_b)
+                               and not cut(p_b, j))
+                    w4_ok = (path_up and active[j]
+                             and np.float32(px_fwd[j, b]) >= thr2)
+                    if w4_ok and not px_deliver:
+                        px_src = p_b
+                        px_deliver = True
+                    if w4_ok and np.float32(px_back[j, b]) >= thr2:
+                        relayed_lane = True
+                if px_deliver:
+                    deliveries.append((j, px_src))
+                aq = draw_id(j, ack_u[j])
+                if (active[j] and active[aq] and not cut(j, aq)
+                        and np.float32(ack_leg[j]) >= thr2):
+                    deliveries.append((j, aq))
+                failed[j] = probe_live and not (acked_lane or relayed_lane)
+            for dst, sender in deliveries:
+                for sl in sel_cache[sender]:
+                    st.knows[dst, sl] = True
+            susp_sub = list(range(n))
+            susp_org = [int(x) for x in src_arr]
+            view_rows = [int(x) for x in src_arr]
+
+        # --- Phase C: suspicion verdicts (views read post-delivery) ---------
         mk_suspect = np.zeros(n, bool)
         re_suspect = np.zeros(n, bool)
         susp_key = np.zeros(n, np.uint32)
         for i in range(n):
             if not failed[i]:
                 continue
-            vk = view_of(i, tgt[i])
+            vk = view_of(view_rows[i], susp_sub[i])
             stt = key_status(vk)
             if stt == Status.ALIVE:
                 mk_suspect[i] = True
@@ -418,7 +517,8 @@ class RingOracle:
                               i, -1, False))
         for i in range(n):
             if mk_suspect[i] or re_suspect[i]:
-                cands.append((tgt[i], int(susp_key[i]), i, -1, True))
+                cands.append((susp_sub[i], int(susp_key[i]),
+                              susp_org[i], -1, True))
         total = len(cands)
         cands = cands[:ob]
         self.state.overflow = st.overflow + max(total - ob, 0)

@@ -201,3 +201,61 @@ class TestStudyRunner:
         assert int(np.asarray(res_d.series.dead_views)[-1]) == 2 * live
         assert int(np.asarray(res_r.series.false_dead_views).max()) == 0
         assert int(np.asarray(res_d.series.false_dead_views).max()) == 0
+
+
+class TestPullMode:
+    """Pull-uniform probe mode (ring.py deviations P1-P4): bitwise vs the
+    oracle, plus the statistical law it exists to preserve."""
+
+    def test_crash_lifecycle_bitwise(self):
+        n = 32
+        cfg = SwimConfig(n_nodes=n, ring_probe="pull")
+        plan = faults.with_crashes(faults.none(n), [5], [2])
+        orc, _ = run_both(cfg, plan, 26, seed=1)
+        assert key_status(int(orc.gone_key[5])) == Status.DEAD
+
+    def test_loss_partition_join_bitwise(self):
+        n = 24
+        cfg = SwimConfig(n_nodes=n, ring_probe="pull")
+        plan = faults.with_loss(faults.none(n), 0.1)
+        plan = faults.with_partition(plan, faults.halves(n), 3, 9)
+        plan = faults.with_joins(plan, [20], [5])
+        run_both(cfg, plan, 18, seed=4)
+
+    def test_geometric_detection_law(self):
+        """The point of pull mode: uniform probing's first-detection
+        latency is Geometric(p) with p = 1-(1-1/(N-1))^L — mean within a
+        4-sigma CLT band of the analytic expectation (~ e/(e-1))."""
+        import math
+
+        from swim_tpu.sim import runner
+
+        N, C = 2048, 48
+        lats = []
+        for seed in (0, 1, 2):
+            cfg = SwimConfig(n_nodes=N, ring_probe="pull")
+            victims = np.linspace(0, N - 1, C).astype(np.int32)
+            plan = faults.with_crashes(faults.none(N), victims, 2)
+            res = runner.run_study_ring(cfg, ring.init_state(cfg), plan,
+                                        jax.random.key(seed), 18)
+            first = np.asarray(res.track.first_suspect)[victims]
+            assert (first != int(runner.NEVER)).all()
+            lats.append(first - 2 + 1)
+        lats = np.concatenate(lats)
+        live = N - C
+        p = 1.0 - (1.0 - 1.0 / (N - 1)) ** live
+        expect = 1.0 / p
+        sigma = math.sqrt(1.0 - p) / p
+        band = 4.0 * sigma / math.sqrt(len(lats))
+        assert abs(float(lats.mean()) - expect) < band, (
+            f"{lats.mean():.3f} outside {expect:.3f} ± {band:.3f}")
+
+
+def test_lifeguard_join_rotor_bitwise():
+    """Rotor + Lifeguard + join churn: LHA must stay untouched on idle
+    periods (unjoined rotor target) — engine and oracle agree bitwise."""
+    n = 16
+    cfg = SwimConfig(n_nodes=n, lifeguard=True)
+    plan = faults.with_joins(faults.none(n), [10, 11, 12, 13], [5])
+    plan = faults.with_loss(plan, 0.3)
+    run_both(cfg, plan, 12, seed=3)

@@ -234,9 +234,17 @@ def run_tier(tier: str, platform: str, nodes: int, periods: int,
     cmd = [sys.executable, os.path.abspath(__file__),
            "--_tier", tier, "--platform", platform,
            "--nodes", str(nodes), "--periods", str(periods)]
+    env = dict(os.environ)
+    if platform == "cpu":
+        # a CPU child must not dial the axon tunnel: when the tunnel is
+        # unhealthy, /root/.axon_site/sitecustomize.py (gated on this
+        # var) hangs the interpreter at STARTUP — before any in-process
+        # platform override can run
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
-                           text=True, cwd=os.path.dirname(
+                           text=True, env=env, cwd=os.path.dirname(
                                os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"ok": False, "tier": tier, "nodes": nodes,

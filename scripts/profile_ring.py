@@ -1,0 +1,105 @@
+"""Profile one ring-engine period on the current backend.
+
+Usage: python scripts/profile_ring.py [N] [--periods P] [--trace DIR]
+                                      [--probe rotor|pull] [--top K]
+
+Times a jitted multi-period run, then (with --trace) writes a
+jax.profiler trace and prints the top-K XLA ops by self time parsed
+straight out of the .trace.json.gz — no TensorBoard needed.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+args = sys.argv[1:]
+
+
+def opt(name, default=None):
+    if name in args:
+        i = args.index(name)
+        v = args[i + 1]
+        del args[i:i + 2]
+        return v
+    return default
+
+
+trace_dir = opt("--trace")
+periods = int(opt("--periods", "5"))
+probe = opt("--probe", "rotor")
+top_k = int(opt("--top", "25"))
+n = int(args[0]) if args else 1_000_000
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import ring
+from swim_tpu.sim import faults
+
+cfg = SwimConfig(n_nodes=n, ring_probe=probe)
+plan = faults.with_random_crashes(
+    faults.none(n), jax.random.key(1), 0.001, 0, periods)
+state = ring.init_state(cfg)
+key = jax.random.key(0)
+
+run = jax.jit(lambda st: ring.run(cfg, st, plan, key, periods))
+t0 = time.perf_counter()
+out = jax.block_until_ready(run(state))
+print(f"compile+first: {time.perf_counter() - t0:.2f}s "
+      f"(platform={jax.devices()[0].platform})")
+t0 = time.perf_counter()
+out = jax.block_until_ready(run(state))
+dt = time.perf_counter() - t0
+print(f"{periods} periods: {dt:.3f}s -> {dt / periods * 1e3:.1f} ms/period, "
+      f"{periods / dt:.2f} periods/sec @ N={n} probe={probe}")
+
+if not trace_dir:
+    sys.exit(0)
+
+with jax.profiler.trace(trace_dir):
+    jax.block_until_ready(run(state))
+
+# ---- parse the trace: top ops by device self-time -------------------------
+paths = sorted(glob.glob(os.path.join(
+    trace_dir, "**", "*.trace.json.gz"), recursive=True), key=os.path.getmtime)
+if not paths:
+    sys.exit(f"no trace.json.gz under {trace_dir}")
+with gzip.open(paths[-1], "rt") as f:
+    tr = json.load(f)
+
+# device lanes only (TPU/xla ops live on pids whose process name mentions
+# the device); fall back to every complete event if the filter comes up dry
+proc_name: dict[int, str] = {}
+for ev in tr.get("traceEvents", []):
+    if ev.get("ph") == "M" and ev.get("name") == "process_name":
+        proc_name[ev["pid"]] = ev.get("args", {}).get("name", "")
+
+by_op: dict[str, float] = defaultdict(float)
+count: dict[str, int] = defaultdict(int)
+total = 0.0
+for ev in tr.get("traceEvents", []):
+    if ev.get("ph") != "X":
+        continue
+    pname = proc_name.get(ev.get("pid"), "")
+    if ("TPU" not in pname and "/device" not in pname
+            and "Chip" not in pname and "XLA" not in pname):
+        continue
+    dur = float(ev.get("dur", 0.0))
+    name = ev.get("name", "?")
+    by_op[name] += dur
+    count[name] += 1
+    total += dur
+
+print(f"\ntrace: {paths[-1]}")
+print(f"device events total: {total / 1e6:.3f}s "
+      f"(over {periods} profiled periods)")
+print(f"{'self us':>12} {'calls':>7}  op")
+for name, us in sorted(by_op.items(), key=lambda kv: -kv[1])[:top_k]:
+    print(f"{us:12.0f} {count[name]:7d}  {name[:110]}")

@@ -17,12 +17,25 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "bench_results")
 
+# When the axon tunnel is unhealthy, /root/.axon_site/sitecustomize.py hangs
+# EVERY python interpreter at startup (its register() dials the tunnel,
+# gated on PALLAS_AXON_POOL_IPS).  To keep the watcher itself immune, launch
+# it as:
+#   AXON_POOL_IPS_BACKUP="$PALLAS_AXON_POOL_IPS" \
+#   env -u PALLAS_AXON_POOL_IPS python scripts/tpu_watch.py
+# The watcher then restores the variable for its CHILDREN only, so probe and
+# capture subprocesses still see the TPU (and a hung child is just a timeout).
+CHILD_ENV = dict(os.environ)
+_backup = os.environ.get("AXON_POOL_IPS_BACKUP")
+if _backup and not CHILD_ENV.get("PALLAS_AXON_POOL_IPS"):
+    CHILD_ENV["PALLAS_AXON_POOL_IPS"] = _backup
+
 
 def probe(timeout: float = 120.0) -> bool:
     code = "import jax; d=jax.devices(); print(d[0].platform, len(d))"
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True)
+                           capture_output=True, text=True, env=CHILD_ENV)
         return r.returncode == 0 and "tpu" in r.stdout
     except subprocess.SubprocessError:
         return False
@@ -32,7 +45,7 @@ def run_save(name: str, cmd: list[str], timeout: float) -> bool:
     print(f"[tpu_watch] running {name}: {' '.join(cmd)}", flush=True)
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
-                           text=True, cwd=REPO)
+                           text=True, cwd=REPO, env=CHILD_ENV)
     except subprocess.SubprocessError as e:
         print(f"[tpu_watch] {name} failed: {e}", flush=True)
         return False
@@ -44,11 +57,14 @@ def run_save(name: str, cmd: list[str], timeout: float) -> bool:
             break
         except json.JSONDecodeError:
             continue
-    with open(os.path.join(OUT, f"{name}.json"), "w") as f:
+    final = os.path.join(OUT, f"{name}.json")
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
         json.dump({"cmd": cmd, "rc": r.returncode, "result": payload,
                    "stderr_tail": (r.stderr or "")[-2000:],
                    "captured_at": time.strftime("%Y-%m-%d %H:%M:%S")},
                   f, indent=1)
+    os.replace(tmp, final)
     print(f"[tpu_watch] {name}: rc={r.returncode} "
           f"parsed={'yes' if payload else 'no'}", flush=True)
     return r.returncode == 0 and payload is not None
@@ -65,12 +81,12 @@ def main() -> int:
             ok = run_save("bench_all",
                           [sys.executable, "bench.py", "--tier", "all"],
                           3600)
-            run_save("study_suspicion_1m", [
+            ok &= run_save("study_suspicion_1m", [
                 sys.executable, "-m", "swim_tpu.cli", "study",
                 "suspicion_sweep", "--nodes", "1000000", "--engine",
                 "ring", "--periods", "100", "--mults", "3.0", "5.0"],
                 3600)
-            run_save("study_lifeguard_1m", [
+            ok &= run_save("study_lifeguard_1m", [
                 sys.executable, "-m", "swim_tpu.cli", "study",
                 "lifeguard", "--nodes", "1000000", "--engine", "ring",
                 "--periods", "100"], 3600)

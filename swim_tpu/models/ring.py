@@ -187,11 +187,14 @@ PULL_SRC_ATTEMPTS = 3
 
 def pow_f32(base, expo):
     """base**expo for f32 base and non-negative i32 expo, by 31 rounds of
-    square-and-multiply in a FIXED operation order.  IEEE-754 f32 multiply
-    and divide are correctly rounded, so evaluating the identical
-    operation sequence in jnp (engine) and numpy (oracle) yields
-    bit-identical results on every backend — which is what lets the
-    pull-mode probed decision stay part of the bitwise contract."""
+    square-and-multiply in a FIXED operation order.  IEEE-754 f32
+    multiply is correctly rounded on every backend, so evaluating the
+    identical operation sequence in jnp (engine) and numpy (oracle)
+    yields bit-identical results — which is what lets the pull-mode
+    probed decision stay part of the bitwise contract.  (The base itself
+    must be divide-free on device: see the reciprocal table at the p0
+    computation — XLA:TPU f32 divide is not guaranteed correctly
+    rounded.)"""
     one = jnp.float32(1.0)
     result = jnp.broadcast_to(one, jnp.shape(expo)).astype(jnp.float32)
     cur = jnp.broadcast_to(jnp.asarray(base, jnp.float32),
@@ -313,6 +316,23 @@ def _select_first_b(win_masked, b: int):
             budget = budget - (bitm != 0).astype(jnp.int32)
         taken[w] = acc
     return jnp.stack(taken, axis=-1)
+
+
+def _col_select_multi(mat: jax.Array, cols: list[jax.Array]) -> list[jax.Array]:
+    """[mat[i, c[i]] for c in cols], as ONE streamed pass over `mat`.
+
+    `mat[rows, col]` with per-row dynamic columns lowers to XLA's generic
+    gather, which TPU executes near-serially (measured: 13–21 ms per
+    1M-row gather — the round-2 profile's entire hot set).  A fused
+    select loop over the static column count instead reads `mat` exactly
+    once at HBM bandwidth and serves every query in `cols` from the same
+    pass.  Each `c` must be pre-clamped into [0, mat.shape[1])."""
+    accs = [jnp.zeros(mat.shape[:1], mat.dtype) for _ in cols]
+    for w in range(mat.shape[1]):
+        cw = mat[:, w]
+        for j, c in enumerate(cols):
+            accs[j] = accs[j] | jnp.where(c == w, cw, jnp.zeros_like(cw))
+    return accs
 
 
 def resolved_words(cfg: SwimConfig, state: RingState) -> jax.Array:
@@ -529,21 +549,28 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # Rotor: target(i) = i + s_t; every wave is a roll (deviation R1).
         s_off = rnd.s_off
         target = jnp.mod(ids + s_off, n)
-        # a not-yet-joined target is in nobody's membership list: idle
-        prober = active & joined[target]
 
         def roll_from(x, d):
             """Value of x at node (i + d) mod n, for each i (d traced)."""
             return jnp.roll(x, -d, axis=0)
 
-        def buddy_bits(subj):
+        # a not-yet-joined target is in nobody's membership list: idle.
+        # (joined[target] is a rotation — roll, never gather: see
+        # _col_select_multi's docstring for the measured cost gap.)
+        prober = active & roll_from(joined, s_off)
+
+        def buddy_bits(d):
             """u32[N, WW]: forced window bit of the suspect witness about
-            subj[i], when sender i knows it and it is in the window."""
+            subject (i + d) mod n, when sender i knows it and it is in
+            the window.  Subject-table lookups are rolls; the sender's
+            own word is a streamed window column-select (window-only:
+            the result is masked by in_win, so cold never matters)."""
             if not (cfg.lifeguard and cfg.buddy):
                 return no_force
-            slot = sus_slot[subj]
-            kn = knows_bit(ids, slot)
+            slot = roll_from(sus_slot, d)
             in_win, wcol, _, bit = slot_pos(slot)
+            (wword,) = _col_select_multi(win, [wcol])
+            kn = (slot >= 0) & (((wword >> bit) & 1) > 0)
             usebit = kn & in_win
             onehot_w = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]
                         == wcol[:, None])
@@ -557,7 +584,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                     & (u >= loss_f))
 
         # W1: ping i -> i+s.  Receiver j hears from sender j−s.
-        sel1 = sel_now(buddy_bits(target))
+        sel1 = sel_now(buddy_bits(s_off))
         ok1 = wave_ok(prober & active, -s_off, rnd.loss_w1)  # per recv j
         win = win | jnp.where(ok1[:, None], roll_from(sel1, -s_off),
                               jnp.uint32(0))
@@ -581,7 +608,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                                   jnp.uint32(0))
             # W4: proxy ping p -> p+d4 (the original target j=i+s).
             # Receiver j hears from j−d4 = p.
-            sel4 = sel_now(buddy_bits(jnp.mod(ids + d4, n)))
+            sel4 = sel_now(buddy_bits(d4))
             ok4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a])       # per recv j
             win = win | jnp.where(ok4[:, None], roll_from(sel4, -d4),
                                   jnp.uint32(0))
@@ -609,7 +636,27 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             thin = rnd.lha_u < (jnp.float32(1.0)
                                 / (1 + s_probe).astype(jnp.float32))
             failed = failed & thin
-        viewed_tk = view_of(ids, target)
+        # view_of(ids, target) + Phase C's self-suspicion word, fused:
+        # subject tables roll (target is a rotation of ids), and all C+1
+        # heard-word queries share ONE streamed pass over win and cold.
+        q_slots = [roll_from(top_slot[lvl], s_off) for lvl in range(g.c)]
+        q_slots.append(sus_slot)               # self query: subj == ids
+        q_pos = [slot_pos(s) for s in q_slots]
+        q_win = _col_select_multi(win, [p[1] for p in q_pos])
+        q_cold = _col_select_multi(cold, [p[2] for p in q_pos])
+        q_kn = []
+        for (ok, _, _, bit), wv, cv, s in zip(q_pos, q_win, q_cold,
+                                              q_slots):
+            word = jnp.where(ok, wv, cv)
+            q_kn.append((s >= 0) & (((word >> bit) & 1) > 0))
+        viewed_tk = jnp.maximum(lattice.alive_key(jnp.uint32(0)),
+                                roll_from(gone_key, s_off))
+        for lvl in range(g.c):
+            viewed_tk = jnp.maximum(
+                viewed_tk, jnp.where(q_kn[lvl],
+                                     roll_from(top_key[lvl], s_off),
+                                     jnp.uint32(0)))
+        self_key = jnp.where(q_kn[g.c], sus_bk, jnp.uint32(0))
         susp_subject = target
         susp_orig = ids
     else:
@@ -642,8 +689,20 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # join-churn aware), and there are L_j live probers besides j.
         members = jnp.sum(joined).astype(jnp.int32)
         lj = live_total - active.astype(jnp.int32)
-        denom = jnp.maximum(members - 1, 1).astype(jnp.float32)
-        base = jnp.float32(1.0) - jnp.float32(1.0) / denom
+        # 1/(M−1) via a HOST-computed f32 reciprocal table rather than a
+        # device divide: IEEE-754 guarantees correctly-rounded f32 mul
+        # (pow_f32's only op), but XLA:TPU may lower f32 divide to a
+        # reciprocal approximation — a 1-ulp base difference would break
+        # the bitwise engine↔oracle contract on the flagship backend.
+        # numpy's host divide is correctly rounded, identical to the
+        # oracle's np.float32 divide by construction.
+        import numpy as _np
+
+        recip = jnp.asarray(
+            _np.float32(1.0)
+            / _np.maximum(_np.arange(n, dtype=_np.float32), 1.0))
+        di = jnp.clip(members - 1, 1, n - 1)
+        base = jnp.float32(1.0) - recip[di]
         p0 = jnp.where(members >= 2, pow_f32(base, jnp.maximum(lj, 0)),
                        jnp.float32(1.0))
         probed = (pr.m_u >= p0) & joined          # only members are probed
@@ -689,7 +748,9 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         win = win | jnp.where(px_deliver[:, None], sel_all[px_src],
                               jnp.uint32(0))
         # ack-direction gossip (P3'): one contact from an independent
-        # uniform draw, delivered iff a ping+ack round trip would be
+        # uniform draw, delivered iff a ping+ack round trip would be —
+        # both legs composed into one draw against thr2 = 1-(1-loss)^2,
+        # the same marginal probability as exact SWIM's ack piggyback
         aq = draw_id(pr.ack_u)
         ack_gossip_ok = (active & active[aq] & ~part_cut(ids, aq)
                          & (pr.ack_leg >= thr2))
@@ -697,6 +758,9 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                               jnp.uint32(0))
         failed = probe_live & ~(acked_lane | relayed_lane)
         viewed_tk = view_of(src, ids)             # src's view of j
+        # Phase C self query: sus_slot/sus_bk indexed by ids is identity
+        self_key = jnp.where(knows_bit(ids, sus_slot), sus_bk,
+                             jnp.uint32(0))
         susp_subject = ids
         susp_orig = src
 
@@ -707,8 +771,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
 
     # ---- Phase C: refutation + sentinel expiry ----------------------------
     # refutation: i knows a suspect rumor about i outranking its aliveness
-    self_key = jnp.where(knows_bit(ids, sus_slot[ids]), sus_bk[ids],
-                         jnp.uint32(0))
+    # (self_key computed per probe branch above, on the fused query pass)
     refute = active & lattice.is_suspect(self_key) & (
         self_key > lattice.alive_key(state.inc_self))
     new_inc = jnp.where(refute, lattice.incarnation_of(self_key) + 1,
@@ -759,7 +822,16 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                                  jnp.ones((n,), jnp.bool_)])
     m_cand = c_valid.shape[0]
     total = jnp.sum(c_valid).astype(jnp.int32)
-    (ci,) = jnp.nonzero(c_valid, size=ob, fill_value=m_cand)
+    # first `ob` true indices, ascending — the semantics of
+    # jnp.nonzero(c_valid, size=ob, fill_value=m_cand), but via top_k:
+    # nonzero's compaction lowers to a full-length scatter, which TPU
+    # serializes (measured 17.5 ms at m_cand ≈ 2M); top_k is a fused
+    # partial sort at bandwidth speed.  Keys are distinct (one per
+    # index), so the descending key order IS ascending index order.
+    ci_key, _ = jax.lax.top_k(
+        jnp.where(c_valid, m_cand - jnp.arange(m_cand, dtype=jnp.int32),
+                  0), ob)
+    ci = jnp.where(ci_key > 0, m_cand - ci_key, m_cand)
     got = ci < m_cand
     ci = jnp.minimum(ci, m_cand - 1)
     subj_c = jnp.where(got, c_subj[ci], -1)

@@ -352,22 +352,106 @@ def resolved_words(cfg: SwimConfig, state: RingState) -> jax.Array:
     return jnp.where(in_win[None, :], state.win[:, wcol], state.cold)
 
 
+class GlobalOps:
+    """Cross-node operations, single-program flavor: the whole node axis
+    is local, so every method is ordinary array code.
+
+    `step` routes ALL cross-node data movement through this object:
+    node-axis rolls, global reductions, scatter/gather by global node id,
+    heard-bit lookups for arbitrary node rows, and first-k-true index
+    compaction.  swim_tpu/parallel/ring_shard.py supplies the shard_map
+    twin (ShardOps) whose methods compute the same VALUES from a node
+    shard plus XLA collectives (collective-permute rolls, psum
+    reductions, masked local scatters) — one step body, two execution
+    layouts, bitwise-equal results.
+    """
+
+    supports_random_gather = True   # pull mode's arbitrary row gathers
+
+    def __init__(self, cfg: SwimConfig):
+        self.n = cfg.n_nodes
+
+    # -- node identity ----------------------------------------------------
+    def ids(self):
+        """i32: global ids of the locally-held node rows."""
+        return jnp.arange(self.n, dtype=jnp.int32)
+
+    def zeros_nodes(self, dtype, cols: int | None = None):
+        shape = (self.n,) if cols is None else (self.n, cols)
+        return jnp.zeros(shape, dtype)
+
+    def full_nodes(self, val, dtype):
+        return jnp.full((self.n,), val, dtype)
+
+    # -- reductions -------------------------------------------------------
+    def gsum(self, partial):
+        """Global sum given this shard's partial (scalar or small vec)."""
+        return partial
+
+    # -- communication ----------------------------------------------------
+    def roll_from(self, x, d):
+        """Value of x at node (i + d) mod n, for every local row i."""
+        return jnp.roll(x, -d, axis=0)
+
+    # -- node-axis scatter/gather by GLOBAL node id -----------------------
+    def scatter_max(self, dst, idx, val):
+        """dst[idx] <- max(dst[idx], val); idx outside [0, n) drops."""
+        return dst.at[idx].max(val, mode="drop")
+
+    def scatter_add(self, dst, idx, val):
+        return dst.at[idx].add(val, mode="drop")
+
+    def scatter_or_word(self, win, rows, cols, bits):
+        """win[rows, cols] |= bits via add (caller guarantees the added
+        bits are disjoint from existing ones); rows outside [0, n) drop."""
+        return win.at[rows, cols].add(bits, mode="drop")
+
+    def gather(self, arr, idx):
+        """arr[idx] for node-axis arr; idx replicated, in [0, n)."""
+        return arr[idx]
+
+    def knows_words(self, win, cold, slot_pos, rows, slot):
+        """Heard-bit of GLOBAL node ids `rows` (any shape) for ring
+        slots `slot` (same shape): the generic two-level word lookup."""
+        ok, wcol, word_r, bit = slot_pos(slot)
+        word = jnp.where(ok, win[rows, wcol], cold[rows, word_r])
+        return (slot >= 0) & (((word >> bit) & 1) > 0)
+
+    def first_true_nodes(self, valid, k):
+        """Ascending global ids of the first k True entries of a
+        node-axis bool vector; missing entries fill with n."""
+        key = jnp.where(valid, self.n - self.ids(), 0)
+        kk, _ = jax.lax.top_k(key, min(k, self.n))
+        idx = jnp.where(kk > 0, self.n - kk, self.n)
+        if k > self.n:
+            idx = jnp.concatenate(
+                [idx, jnp.full((k - self.n,), self.n, jnp.int32)])
+        return idx
+
+
 def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
-         rnd: RingRandomness) -> RingState:
-    """One protocol period for all N nodes (pure; jit with cfg static)."""
+         rnd: RingRandomness, ops: GlobalOps | None = None) -> RingState:
+    """One protocol period for all N nodes (pure; jit with cfg static).
+
+    With the default `ops`, every array spans the full node axis; under
+    swim_tpu/parallel/ring_shard.py the same body runs inside shard_map
+    with node-axis tensors sharded and `ops` supplying the collectives.
+    """
+    if ops is None:
+        ops = GlobalOps(cfg)
     g = geometry(cfg)
     n, k = cfg.n_nodes, cfg.k_indirect
     r_tot, s_cap = g.rw * WORD, cfg.sentinels
     ob = g.ow * WORD
     t = state.step
-    ids = jnp.arange(n, dtype=jnp.int32)
+    ids = ops.ids()
     rr = jnp.arange(r_tot, dtype=jnp.int32)
     lanes = jnp.arange(ob, dtype=jnp.int32)
     crashed = t >= plan.crash_step
     joined = t >= plan.join_step
     active = ~crashed & joined
     part_on = (t >= plan.partition_start) & (t < plan.partition_end)
-    live_total = jnp.sum(active).astype(jnp.int32)
+    live_total = ops.gsum(jnp.sum(active).astype(jnp.int32))
 
     subject, rkey, birth0 = state.subject, state.rkey, state.birth0
     snode, stime = state.sent_node, state.sent_time
@@ -381,11 +465,11 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
 
     # ---- Phase 0a: judge the outgoing words (entry win cols [0, OW)) ------
     out_cols = state.win[:, :g.ow]                             # u32[N, OW]
-    out_knowers = jnp.stack(
+    out_knowers = ops.gsum(jnp.stack(
         [jnp.sum(jnp.where(
             active, (out_cols[:, la // WORD] >> jnp.uint32(la % WORD))
             & jnp.uint32(1), jnp.uint32(0))).astype(jnp.int32)
-         for la in range(ob)])                                 # i32[OB]
+         for la in range(ob)]))                                # i32[OB]
     out_rcol = jnp.mod(entry_gw0 + lanes // WORD, g.rw)
     out_slots = out_rcol * WORD + lanes % WORD                 # i32[OB]
     out_sub = subject[out_slots]
@@ -401,7 +485,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     glob_refuted = (jnp.any(
         (subject[None, :] == out_sub[:, None]) & (subject >= 0)[None, :]
         & (rkey[None, :] > out_key[:, None]), axis=-1)
-        | (gone_key[jnp.maximum(out_sub, 0)] > out_key))
+        | (ops.gather(gone_key, jnp.maximum(out_sub, 0)) > out_key))
     pending = (out_used & lattice.is_suspect(out_key)
                & ~confirmed[out_slots] & ~glob_refuted)
     carry = out_used & ~out_dissem & in_budget
@@ -414,8 +498,8 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # without this, a refutation that disseminates and retires would
     # become invisible to later sentinel-expiry checks.
     tomb = retire & out_dissem
-    gone_key = gone_key.at[jnp.where(tomb, out_sub, n)].max(
-        out_key, mode="drop")
+    gone_key = ops.scatter_max(gone_key, jnp.where(tomb, out_sub, n),
+                               out_key)
     # a death evicted before full dissemination is a lost certificate
     overflow = overflow + jnp.sum(retire & out_dead & ~out_dissem
                                   ).astype(jnp.int32)
@@ -426,17 +510,17 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     inv_sub = subject[fresh_slots]
     inv_used = inv_sub >= 0
     inv_key = rkey[fresh_slots]
-    inv_knowers = jnp.stack(
+    inv_knowers = ops.gsum(jnp.stack(
         [jnp.sum(jnp.where(
             active,
             (jax.lax.dynamic_index_in_dim(
                 cold, jnp.mod(fresh_gw0 + la // WORD, g.rw), axis=1,
                 keepdims=False) >> jnp.uint32(la % WORD)) & jnp.uint32(1),
             jnp.uint32(0))).astype(jnp.int32)
-         for la in range(ob)])
+         for la in range(ob)]))
     inv_tomb = inv_used & (inv_knowers >= live_total)
-    gone_key = gone_key.at[jnp.where(inv_tomb, inv_sub, n)].max(
-        inv_key, mode="drop")
+    gone_key = ops.scatter_max(gone_key, jnp.where(inv_tomb, inv_sub, n),
+                               inv_key)
     # kept (pending-suspicion) slots reaped here had life >= timeout + 4
     # periods — their timers have provably resolved, so reaping is silent
     subject = subject.at[jnp.where(inv_used, fresh_slots, r_tot)].set(
@@ -480,27 +564,30 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # ---- per-subject top-C index (R3) -------------------------------------
     used = subject >= 0
     sub_or_n = jnp.where(used, subject, n)
+    subj_cl = jnp.maximum(subject, 0)
     top_key, top_slot = [], []
     remaining = used
     for _ in range(g.c):
-        bk = jnp.zeros((n,), jnp.uint32).at[
-            jnp.where(remaining, subject, n)].max(rkey, mode="drop")
-        bk_at_r = bk[jnp.maximum(subject, 0)]
+        bk = ops.scatter_max(ops.zeros_nodes(jnp.uint32),
+                             jnp.where(remaining, subject, n), rkey)
+        bk_at_r = ops.gather(bk, subj_cl)
         hit = remaining & (rkey == bk_at_r) & (bk_at_r > 0)
-        bs = jnp.full((n,), -1, jnp.int32).at[
-            jnp.where(hit, subject, n)].max(rr, mode="drop")
+        bs = ops.scatter_max(ops.full_nodes(-1, jnp.int32),
+                             jnp.where(hit, subject, n), rr)
         top_key.append(bk)
         top_slot.append(bs)
-        remaining = remaining & ~(rr == bs[jnp.maximum(subject, 0)])
-    n_per_subj = jnp.zeros((n,), jnp.int32).at[sub_or_n].add(1, mode="drop")
-    index_overflow = state.index_overflow + jnp.sum(
-        n_per_subj > g.c).astype(jnp.int32)
+        remaining = remaining & ~(rr == ops.gather(bs, subj_cl))
+    n_per_subj = ops.scatter_add(ops.zeros_nodes(jnp.int32), sub_or_n,
+                                 jnp.int32(1))
+    index_overflow = state.index_overflow + ops.gsum(jnp.sum(
+        n_per_subj > g.c).astype(jnp.int32))
     sus_hit = used & lattice.is_suspect(rkey)
-    sus_bk = jnp.zeros((n,), jnp.uint32).at[
-        jnp.where(sus_hit, subject, n)].max(rkey, mode="drop")
-    sus_slot = jnp.full((n,), -1, jnp.int32).at[
-        jnp.where(sus_hit & (rkey == sus_bk[jnp.maximum(subject, 0)]),
-                  subject, n)].max(rr, mode="drop")
+    sus_bk = ops.scatter_max(ops.zeros_nodes(jnp.uint32),
+                             jnp.where(sus_hit, subject, n), rkey)
+    sus_slot = ops.scatter_max(
+        ops.full_nodes(-1, jnp.int32),
+        jnp.where(sus_hit & (rkey == ops.gather(sus_bk, subj_cl)),
+                  subject, n), rr)
 
     def slot_pos(slot):
         """(in_win, win_col, ring_word, bit) for ring slot array `slot`."""
@@ -512,13 +599,14 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                 jnp.minimum(off, g.ww - 1), word_r, bit)
 
     def knows_bit(rows, slot):
-        """bool[shape]: does node rows[...] know ring slot slot[...]?"""
-        ok, wcol, word_r, bit = slot_pos(slot)
-        word = jnp.where(ok, win[rows, wcol], cold[rows, word_r])
-        return (slot >= 0) & (((word >> bit) & 1) > 0)
+        """bool[shape]: does node rows[...] (GLOBAL ids) know slot[...]?"""
+        return ops.knows_words(win, cold, slot_pos, rows, slot)
 
     def view_of(rows, subj):
-        """u32[shape]: rows[...]'s opinion key of subj[...] (top-C join)."""
+        """u32[shape]: rows[...]'s opinion key of subj[...] (top-C join).
+
+        Arbitrary-row indexing — pull-mode (GlobalOps) only; the rotor
+        path uses the fused roll/column-select queries below."""
         best = jnp.maximum(lattice.alive_key(jnp.uint32(0)), gone_key[subj])
         for lvl in range(g.c):
             slot = top_slot[lvl][subj]
@@ -542,17 +630,14 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     def sel_now(forced):
         return _select_first_b(win & elig_mask[None, :], b_pig) | forced
 
-    no_force = jnp.zeros((n, g.ww), jnp.uint32)
+    no_force = ops.zeros_nodes(jnp.uint32, g.ww)
     lha = state.lha
 
     if cfg.ring_probe == "rotor":
         # Rotor: target(i) = i + s_t; every wave is a roll (deviation R1).
         s_off = rnd.s_off
         target = jnp.mod(ids + s_off, n)
-
-        def roll_from(x, d):
-            """Value of x at node (i + d) mod n, for each i (d traced)."""
-            return jnp.roll(x, -d, axis=0)
+        roll_from = ops.roll_from
 
         # a not-yet-joined target is in nobody's membership list: idle.
         # (joined[target] is a rotation — roll, never gather: see
@@ -597,7 +682,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         acked = ok2 & prober
 
         need = prober & ~acked
-        relayed = jnp.zeros((n,), jnp.bool_)
+        relayed = ops.zeros_nodes(jnp.bool_)
         for a in range(k):
             q = rnd.q_off[a]
             d4 = s_off - q
@@ -682,6 +767,10 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         #       the draw is decoupled from the node's simulated out-probe.
         #   P4. Each two-hop message path composes its two loss legs into
         #       one draw against (1−loss)²  (same marginal probability).
+        if not ops.supports_random_gather:
+            raise NotImplementedError(
+                "pull-uniform probing needs arbitrary-row gathers; the "
+                "sharded ring engine supports the rotor flagship only")
         pr = rnd.pull
         sel_all = sel_now(no_force)
         # P(m_j = 0) = (1 − 1/(M−1))^{L_j}: a live prober picks uniformly
@@ -787,15 +876,18 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         timeout = dynamic_timeout_table(cfg)[jnp.clip(filled, 0, s_cap)]
     else:
         timeout = jnp.full((r_tot,), cfg.suspicion_periods, jnp.int32)
-    sent_alive = (snode >= 0) & (plan.crash_step[jnp.maximum(snode, 0)] > t)
+    sent_alive = ((snode >= 0)
+                  & (ops.gather(plan.crash_step,
+                                jnp.maximum(snode, 0)) > t))
     deadline_hit = sent_alive & (t >= stime + timeout[:, None])
     is_susp_r = lattice.is_suspect(rkey)
     subj_r = jnp.maximum(subject, 0)
-    higher_known = jnp.broadcast_to((gone_key[subj_r] > rkey)[:, None],
+    gone_at_r = ops.gather(gone_key, subj_r)
+    higher_known = jnp.broadcast_to((gone_at_r > rkey)[:, None],
                                     snode.shape)
     for lvl in range(g.c):
-        oslot = top_slot[lvl][subj_r]                          # [R]
-        okey = top_key[lvl][subj_r]
+        oslot = ops.gather(top_slot[lvl], subj_r)              # [R]
+        okey = ops.gather(top_key[lvl], subj_r)
         cand = ((okey > rkey) & (oslot >= 0))[:, None]
         kn = knows_bit(jnp.maximum(snode, 0),
                        jnp.broadcast_to(oslot[:, None], snode.shape))
@@ -803,42 +895,60 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     can_confirm = deadline_hit & ~higher_known
     dead_key_r = lattice.dead_key(lattice.incarnation_of(rkey))
     confirm = (used & is_susp_r & ~confirmed
-               & (dead_key_r > gone_key[subj_r])
+               & (dead_key_r > gone_at_r)
                & jnp.any(can_confirm, axis=-1))
     conf_s = jnp.argmax(can_confirm, axis=-1)
     conf_node = jnp.take_along_axis(snode, conf_s[:, None], axis=-1)[:, 0]
 
     # ---- Phase D: new originations into the free fresh lanes --------------
     # Channels, priority order: confirms > refutes > new/independent
-    # suspicions (carried lanes were already placed in Phase 0).
-    c_subj = jnp.concatenate([subject, ids, susp_subject])
-    c_key = jnp.concatenate([dead_key_r, lattice.alive_key(new_inc),
-                             susp_key])
-    c_orig = jnp.concatenate([jnp.maximum(conf_node, 0), ids,
-                              susp_orig])
-    c_valid = jnp.concatenate([confirm, refute, mk_suspect | re_suspect])
-    c_srcslot = jnp.concatenate([rr, jnp.full((2 * n,), -1, jnp.int32)])
-    c_is_susp = jnp.concatenate([jnp.zeros((r_tot + n,), jnp.bool_),
-                                 jnp.ones((n,), jnp.bool_)])
-    m_cand = c_valid.shape[0]
-    total = jnp.sum(c_valid).astype(jnp.int32)
-    # first `ob` true indices, ascending — the semantics of
-    # jnp.nonzero(c_valid, size=ob, fill_value=m_cand), but via top_k:
-    # nonzero's compaction lowers to a full-length scatter, which TPU
-    # serializes (measured 17.5 ms at m_cand ≈ 2M); top_k is a fused
-    # partial sort at bandwidth speed.  Keys are distinct (one per
-    # index), so the descending key order IS ascending index order.
-    ci_key, _ = jax.lax.top_k(
-        jnp.where(c_valid, m_cand - jnp.arange(m_cand, dtype=jnp.int32),
-                  0), ob)
-    ci = jnp.where(ci_key > 0, m_cand - ci_key, m_cand)
+    # suspicions (carried lanes were already placed in Phase 0).  The
+    # global candidate list is indexed [0,R) = confirms (replicated),
+    # [R, R+N) = refutes, [R+N, R+2N) = suspicions (node-axis); its
+    # first OB true entries ascending — exactly the priority order — are
+    # found per channel and merged.  top_k, never nonzero: nonzero's
+    # compaction lowers to a full-length scatter, which TPU serializes
+    # (measured 17.5 ms at ~2M candidates); and per-channel compaction
+    # is what lets the sharded ops find its node-axis candidates with
+    # one small all-gather instead of a global scatter.
+    suspect = mk_suspect | re_suspect
+    m_cand = r_tot + 2 * n
+    total = (jnp.sum(confirm).astype(jnp.int32)
+             + ops.gsum(jnp.sum(refute).astype(jnp.int32))
+             + ops.gsum(jnp.sum(suspect).astype(jnp.int32)))
+    kk1, _ = jax.lax.top_k(jnp.where(confirm, r_tot - rr, 0), ob)
+    ci1 = jnp.where(kk1 > 0, r_tot - kk1, m_cand)
+    ci2 = ops.first_true_nodes(refute, ob)
+    ci2 = jnp.where(ci2 < n, r_tot + ci2, m_cand)
+    ci3 = ops.first_true_nodes(suspect, ob)
+    ci3 = jnp.where(ci3 < n, r_tot + n + ci3, m_cand)
+    cand = jnp.concatenate([ci1, ci2, ci3])
+    mk_, _ = jax.lax.top_k(jnp.where(cand < m_cand, m_cand - cand, 0), ob)
+    ci = jnp.where(mk_ > 0, m_cand - mk_, m_cand)
     got = ci < m_cand
-    ci = jnp.minimum(ci, m_cand - 1)
-    subj_c = jnp.where(got, c_subj[ci], -1)
-    key_c = jnp.where(got, c_key[ci], 0)
-    orig_c = jnp.where(got, c_orig[ci], 0)
-    srcslot_c = jnp.where(got, c_srcslot[ci], -1)
-    susp_c = got & c_is_susp[ci]
+    # channel decode + candidate fields (all replicated [OB]; node-axis
+    # values arrive through ops.gather by global id)
+    is1 = ci < r_tot
+    i1 = jnp.clip(ci, 0, r_tot - 1)
+    is2 = got & ~is1 & (ci < r_tot + n)
+    j2 = jnp.clip(ci - r_tot, 0, n - 1)
+    is3 = got & ~is1 & ~is2
+    j3 = jnp.clip(ci - r_tot - n, 0, n - 1)
+    subj_c = jnp.where(
+        got, jnp.where(is1, subject[i1],
+                       jnp.where(is2, j2, ops.gather(susp_subject, j3))),
+        -1)
+    key_c = jnp.where(
+        got, jnp.where(
+            is1, dead_key_r[i1],
+            jnp.where(is2,
+                      lattice.alive_key(ops.gather(new_inc, j2)),
+                      ops.gather(susp_key, j3))), 0)
+    orig_c = jnp.where(
+        got, jnp.where(is1, jnp.maximum(conf_node[i1], 0),
+                       jnp.where(is2, j2, ops.gather(susp_orig, j3))), 0)
+    srcslot_c = jnp.where(got & is1, i1, -1)
+    susp_c = is3
     overflow = overflow + jnp.maximum(total - ob, 0)
 
     # dedup within candidates (earlier wins) and vs the live table
@@ -885,9 +995,9 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     fw = jnp.clip(lane_c // WORD, 0, g.ow - 1)
     fbit = (jnp.clip(lane_c, 0, ob - 1) % WORD).astype(jnp.uint32)
     orig_rows = jnp.where(alloc_ok, orig_c, n)
-    win = win.at[orig_rows, g.ww - g.ow + fw].add(
-        jnp.where(alloc_ok, jnp.uint32(1) << fbit, jnp.uint32(0)),
-        mode="drop")
+    win = ops.scatter_or_word(
+        win, orig_rows, g.ww - g.ow + fw,
+        jnp.where(alloc_ok, jnp.uint32(1) << fbit, jnp.uint32(0)))
 
     # sentinel joins (same scheme as the rumor engine)
     joiner = placed & susp_c

@@ -1,0 +1,258 @@
+"""Explicitly-sharded ring engine: shard_map + collective-permute rolls.
+
+Why this exists: jitting ring.step under GSPMD shardings is *correct* on
+a mesh (the driver dry-runs it), but a `jnp.roll` by a TRACED shift is
+opaque to the partitioner — compiling the sharded step at N=4096/D=8
+inserts 56 all-gathers, 14 of them replicating the full win heard-bit
+matrix every period (~590 MB/period/device of ICI traffic at the 1M-node
+target).  The rotor protocol only needs to MOVE each wave's payload by
+one shared offset.  This module runs the SAME `ring.step` body inside
+`shard_map` with a `ShardOps` object that supplies the TPU-native data
+movement (SURVEY.md §5 "Distributed comm backend"):
+
+  * **Rolls → two collective-permutes.**  A global roll by traced d
+    splits as d = k·S + r (S = rows per shard): every shard's rolled
+    block is a window into shard (me+k) and (me+k+1)'s rows, fetched
+    with static-permutation `ppermute`s selected by a D-way
+    `lax.switch` on k, then stitched with one dynamic slice.  Per roll:
+    2 neighbor-block transfers on ICI — no all-gather, no replication.
+  * **Global reductions → psum** of per-shard partials (all integer —
+    bitwise-exact, no float reassociation concerns).
+  * **Node-axis scatter/gather by global id → masked local ops.**  Each
+    shard applies exactly the updates addressed to its rows (indices
+    outside its range drop); gathers contribute the owned value and
+    psum-merge (single owner per id, so sum == value).
+  * **First-k-true candidate compaction → local top_k + one small
+    all_gather** ([D, OB] keys) + replicated merge, instead of a global
+    scatter over the 2M-entry candidate vector.
+
+The rumor table, fault-plan scalars, and all Phase D allocation logic
+are REPLICATED: every shard computes them from replicated inputs and
+psum/all_gather-merged values, so the copies stay identical by
+construction.  Results are bitwise-equal to the single-program engine —
+tests/test_ring_shard.py runs the full crash lifecycle on the 8-device
+CPU mesh and asserts equality against `ring.step` period by period, and
+pins the compiled HLO's collective set (collective-permutes present, no
+win-sized all-gathers).
+
+Reference parity note: jpfuentes2/swim's transport is process-to-process
+sockets (SURVEY.md §1, tree unavailable — §0); this module is the
+TPU-native analog of its network fan-out, with XLA collectives over
+ICI/DCN in place of UDP datagrams.
+
+Pull-uniform probing (`cfg.ring_probe == "pull"`) needs arbitrary-row
+gathers and is not supported here; the rotor flagship is.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map      # jax >= 0.8
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:                              # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.models import ring
+from swim_tpu.parallel import mesh as pmesh
+from swim_tpu.sim.faults import FaultPlan
+
+AXIS = pmesh.NODE_AXIS
+
+
+class ShardOps:
+    """ring.GlobalOps twin for one node-axis shard inside shard_map.
+
+    Every method returns the same VALUES as GlobalOps computing on the
+    full node axis, restricted to (node-axis results) this shard's rows
+    or (reductions / gathers) replicated across shards.  Must be
+    constructed INSIDE the shard_map-traced function (uses axis_index).
+    """
+
+    supports_random_gather = False
+
+    def __init__(self, cfg: SwimConfig, n_shards: int):
+        self.n = cfg.n_nodes
+        self.d = n_shards
+        self.s = self.n // n_shards
+        self.lo = jax.lax.axis_index(AXIS).astype(jnp.int32) * self.s
+
+    # -- node identity ----------------------------------------------------
+    def ids(self):
+        return self.lo + jnp.arange(self.s, dtype=jnp.int32)
+
+    def zeros_nodes(self, dtype, cols: int | None = None):
+        shape = (self.s,) if cols is None else (self.s, cols)
+        return jnp.zeros(shape, dtype)
+
+    def full_nodes(self, val, dtype):
+        return jnp.full((self.s,), val, dtype)
+
+    # -- reductions -------------------------------------------------------
+    def gsum(self, partial):
+        return jax.lax.psum(partial, AXIS)
+
+    # -- communication ----------------------------------------------------
+    def _rot(self, x, k_static: int):
+        """The block held by shard (me + k) mod D, for every shard."""
+        if k_static % self.d == 0:
+            return x
+        perm = [(p, (p - k_static) % self.d) for p in range(self.d)]
+        return jax.lax.ppermute(x, AXIS, perm)
+
+    def roll_from(self, x, d):
+        """x at global node (i + d) mod n for my rows i: d = k·S + r, so
+        the answer is rows [r, S) of shard me+k plus rows [0, r) of
+        shard me+k+1 — two ppermutes (switch-selected static k) and one
+        dynamic slice."""
+        dd = jnp.mod(jnp.asarray(d, jnp.int32), self.n)
+        k = dd // self.s
+        r = jnp.mod(dd, self.s)
+        a = jax.lax.switch(
+            k, [functools.partial(self._rot, k_static=kk)
+                for kk in range(self.d)], x)
+        b = self._rot(a, 1)
+        ab = jnp.concatenate([a, b], axis=0)
+        return jax.lax.dynamic_slice_in_dim(ab, r, self.s, axis=0)
+
+    # -- node-axis scatter/gather by GLOBAL node id -----------------------
+    def _local(self, idx):
+        """Global index -> local row; anything not owned -> S (drops)."""
+        owned = (idx >= self.lo) & (idx < self.lo + self.s)
+        return jnp.where(owned, idx - self.lo, self.s), owned
+
+    def scatter_max(self, dst, idx, val):
+        li, _ = self._local(idx)
+        return dst.at[li].max(val, mode="drop")
+
+    def scatter_add(self, dst, idx, val):
+        li, _ = self._local(idx)
+        return dst.at[li].add(val, mode="drop")
+
+    def scatter_or_word(self, win, rows, cols, bits):
+        li, _ = self._local(rows)
+        return win.at[li, cols].add(bits, mode="drop")
+
+    def gather(self, arr, idx):
+        li, owned = self._local(idx)
+        v = arr[jnp.clip(li, 0, self.s - 1)]
+        if v.dtype == jnp.bool_:
+            hit = jax.lax.psum(
+                jnp.where(owned, v, False).astype(jnp.int32), AXIS)
+            return hit > 0
+        return jax.lax.psum(
+            jnp.where(owned, v, jnp.zeros((), v.dtype)), AXIS)
+
+    def knows_words(self, win, cold, slot_pos, rows, slot):
+        ok, wcol, word_r, bit = slot_pos(slot)
+        lr, owned = self._local(rows)
+        lrc = jnp.clip(lr, 0, self.s - 1)
+        word = jnp.where(ok, win[lrc, wcol], cold[lrc, word_r])
+        kn = (slot >= 0) & (((word >> bit) & 1) > 0)
+        return jax.lax.psum(
+            jnp.where(owned, kn, False).astype(jnp.int32), AXIS) > 0
+
+    def first_true_nodes(self, valid, k):
+        gk = jnp.where(valid, self.n - self.ids(), 0)
+        kl = min(k, self.s)
+        kk, _ = jax.lax.top_k(gk, kl)
+        merged = jax.lax.all_gather(kk, AXIS).reshape(-1)   # [D * kl]
+        kk2, _ = jax.lax.top_k(merged, min(k, self.d * kl))
+        idx = jnp.where(kk2 > 0, self.n - kk2, self.n)
+        if k > idx.shape[0]:
+            idx = jnp.concatenate(
+                [idx, jnp.full((k - idx.shape[0],), self.n, jnp.int32)])
+        return idx
+
+
+# ---------------------------------------------------------------------------
+# Spec pytrees and the public build/place API
+# ---------------------------------------------------------------------------
+
+
+def _state_specs(cfg: SwimConfig) -> ring.RingState:
+    return ring.RingState(
+        win=P(AXIS, None), cold=P(AXIS, None), inc_self=P(AXIS),
+        lha=P(AXIS), gone_key=P(AXIS),
+        subject=P(), rkey=P(), birth0=P(), sent_node=P(), sent_time=P(),
+        confirmed=P(), overflow=P(), index_overflow=P(), step=P())
+
+
+def _plan_specs() -> FaultPlan:
+    return FaultPlan(crash_step=P(AXIS), loss=P(), partition_id=P(AXIS),
+                     partition_start=P(), partition_end=P(),
+                     join_step=P(AXIS))
+
+
+def _rnd_specs(cfg: SwimConfig) -> ring.RingRandomness:
+    if cfg.ring_probe != "rotor":
+        raise NotImplementedError(
+            "sharded ring engine supports rotor probing only")
+    return ring.RingRandomness(
+        s_off=P(), q_off=P(), loss_w1=P(AXIS), loss_w2=P(AXIS),
+        loss_w3=P(AXIS, None), loss_w4=P(AXIS, None),
+        loss_w5=P(AXIS, None), loss_w6=P(AXIS, None), lha_u=P(AXIS),
+        pull=None)
+
+
+def _check(cfg: SwimConfig, mesh) -> int:
+    d = int(mesh.devices.size)
+    if cfg.n_nodes % d != 0:
+        raise ValueError(
+            f"n_nodes={cfg.n_nodes} must divide over {d} devices")
+    return d
+
+
+def place(cfg: SwimConfig, mesh, state: ring.RingState, plan: FaultPlan):
+    """Device_put state + plan onto the mesh per this engine's specs."""
+    _check(cfg, mesh)
+    st = jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        state, _state_specs(cfg))
+    pl = jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        plan, _plan_specs())
+    return st, pl
+
+
+def _mapped_step(cfg: SwimConfig, mesh):
+    """The shard_mapped (unjitted) step — single source of the specs."""
+    d = _check(cfg, mesh)
+
+    def _step(state, plan, rnd):
+        return ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d))
+
+    return shard_map(
+        _step, mesh=mesh,
+        in_specs=(_state_specs(cfg), _plan_specs(), _rnd_specs(cfg)),
+        out_specs=_state_specs(cfg), check_rep=False)
+
+
+def build_step(cfg: SwimConfig, mesh):
+    """jitted step(state, plan, rnd) with explicit collectives."""
+    return jax.jit(_mapped_step(cfg, mesh))
+
+
+def build_run(cfg: SwimConfig, mesh, periods: int):
+    """jitted run(state, plan, root_key): `periods` under one lax.scan,
+    randomness drawn inside the scan exactly as ring.run does."""
+    sm = _mapped_step(cfg, mesh)
+
+    def run(state, plan, root_key):
+        def body(stt, _):
+            rnd = ring.draw_period_ring(root_key, stt.step, cfg)
+            return sm(stt, plan, rnd), None
+
+        out, _ = jax.lax.scan(body, state, None, length=periods)
+        return out
+
+    return jax.jit(run)

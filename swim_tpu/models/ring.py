@@ -17,10 +17,12 @@ handful of fused streaming passes over ~50 MB of hot state at N=1M:
     worst-case-detection bound, strengthened: every node is also probed
     exactly once per period).  The k proxies use k more shared offsets.
     Every wave's delivery is then `jnp.roll` by a traced scalar — no
-    gather, no scatter — and on a node-sharded mesh a roll lowers to
-    neighbor-chunk ICI transfers, the TPU-native analog of the
-    reference's socket fan-out (SURVEY.md §5 "Distributed comm
-    backend").
+    gather, no scatter.  (GSPMD alone does NOT see a traced-shift roll
+    as a neighbor exchange — it all-gathers; the sharded execution path
+    is swim_tpu/parallel/ring_shard.py, which runs this same step under
+    shard_map with the rolls as collective-permute pairs on ICI — the
+    TPU-native analog of the reference's socket fan-out, SURVEY.md §5
+    "Distributed comm backend".)
   * **Bit-packed heard-sets.**  Which-node-has-heard-which-rumor lives
     in u32 words (32 rumors/word): 8× less HBM traffic than the rumor
     engine's bool[N, R], and the first-B piggyback selection runs as a

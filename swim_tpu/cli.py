@@ -106,12 +106,17 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
             plan, jax.random.key(args.seed + 1), args.crash_fraction,
             0, max(1, args.periods // 2))
     mesh = pmesh.make_mesh()
-    if engine == "shard":
-        from swim_tpu.parallel import shard_engine
+    if engine in ("shard", "ringshard"):
+        if engine == "shard":
+            from swim_tpu.parallel import shard_engine as par_mod
+            state0 = rumor.init_state(cfg)
+        else:
+            from swim_tpu.models import ring
+            from swim_tpu.parallel import ring_shard as par_mod
+            state0 = ring.init_state(cfg)
 
-        state, plan = shard_engine.place(cfg, mesh, rumor.init_state(cfg),
-                                         plan)
-        run_fn = shard_engine.build_run(cfg, mesh, args.periods)
+        state, plan = par_mod.place(cfg, mesh, state0, plan)
+        run_fn = par_mod.build_run(cfg, mesh, args.periods)
 
         def do_run(st):
             return run_fn(st, plan, jax.random.key(args.seed))
@@ -144,7 +149,7 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     live = ~crashed
     if engine == "dense":
         dead_views = np.asarray(lattice.is_dead(state.key))
-    elif engine == "ring":
+    elif engine in ("ring", "ringshard"):
         dead_views = None          # summarized via the dissemination floor
     else:
         dead_views = np.asarray(lattice.is_dead(
@@ -249,7 +254,7 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--crash-fraction", type=float, default=0.01)
     sim.add_argument("--suspicion-mult", type=float, default=5.0)
     sim.add_argument("--lifeguard", action="store_true")
-    sim.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring"),
+    sim.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring", "ringshard"),
                      default="auto")
     sim.add_argument("--profile", default="",
                      help="write a jax.profiler device trace to this dir")
@@ -262,7 +267,7 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--nodes", type=int, default=1000)
     st.add_argument("--periods", type=int, default=100)
     st.add_argument("--seed", type=int, default=0)
-    st.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring"),
+    st.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring", "ringshard"),
                     default="auto")
     st.add_argument("--crash-fraction", type=float, default=0.01)
     st.add_argument("--loss", type=float, default=0.05)

@@ -224,8 +224,14 @@ def place(cfg: SwimConfig, mesh, state: ring.RingState, plan: FaultPlan):
     return st, pl
 
 
-def _mapped_step(cfg: SwimConfig, mesh):
-    """The shard_mapped (unjitted) step — single source of the specs."""
+@functools.lru_cache(maxsize=64)
+def mapped_step(cfg: SwimConfig, mesh):
+    """The shard_mapped (unjitted) step(state, plan, rnd) — the single
+    source of the engine's specs; nestable inside callers' scans (the
+    study runner passes it to run_study_ring).  Memoized per
+    (cfg, mesh): callers pass it as a STATIC jit argument, and a fresh
+    closure per call would defeat the jit cache (one full study-scan
+    recompile per sweep point)."""
     d = _check(cfg, mesh)
 
     def _step(state, plan, rnd):
@@ -239,13 +245,13 @@ def _mapped_step(cfg: SwimConfig, mesh):
 
 def build_step(cfg: SwimConfig, mesh):
     """jitted step(state, plan, rnd) with explicit collectives."""
-    return jax.jit(_mapped_step(cfg, mesh))
+    return jax.jit(mapped_step(cfg, mesh))
 
 
 def build_run(cfg: SwimConfig, mesh, periods: int):
     """jitted run(state, plan, root_key): `periods` under one lax.scan,
     randomness drawn inside the scan exactly as ring.run does."""
-    sm = _mapped_step(cfg, mesh)
+    sm = mapped_step(cfg, mesh)
 
     def run(state, plan, root_key):
         def body(stt, _):

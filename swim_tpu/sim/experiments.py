@@ -52,6 +52,14 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
         step_fn = shard_engine.build_step(cfg, mesh)
         return runner.run_study_rumor(cfg, state, plan, key, periods,
                                       step_fn)
+    if engine == "ringshard":
+        from swim_tpu.models import ring
+        from swim_tpu.parallel import ring_shard
+
+        state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
+                                       plan)
+        return runner.run_study_ring(cfg, state, plan, key, periods,
+                                     ring_shard.mapped_step(cfg, mesh))
     plan = pmesh.shard_state(plan, mesh, n=n)
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
@@ -80,7 +88,7 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
            "suspicion_periods": cfg.suspicion_periods}
     out.update(runner.detection_summary(res, plan, periods))
     out.update(metrics.series_digest(res.series))
-    if engine in ("rumor", "shard", "ring"):
+    if engine in ("rumor", "shard", "ring", "ringshard"):
         out["overflow"] = int(res.state.overflow)
     return out
 
@@ -119,7 +127,7 @@ def fp_sweep(n: int = 100_000, losses: tuple = (0.0, 0.1, 0.2, 0.3),
             "max_incarnation": int(np.asarray(
                 series.max_incarnation).max()),
         }
-        if engine in ("rumor", "shard", "ring"):
+        if engine in ("rumor", "shard", "ring", "ringshard"):
             pt["overflow"] = int(res.state.overflow)
         points.append(pt)
     return {"study": "fp_sweep", "n": n, "periods": periods,

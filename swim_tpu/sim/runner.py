@@ -229,11 +229,16 @@ class RingStudyResult(NamedTuple):
     series: PeriodSeries
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5))
 def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
-                   root_key: jax.Array, periods: int) -> RingStudyResult:
+                   root_key: jax.Array, periods: int,
+                   step_fn=None) -> RingStudyResult:
     """Ring-engine study: the same StudyTrack/PeriodSeries as the other
     engines, computed from the packed heard-bit words.
+
+    `step_fn(state, plan, rnd)` overrides the stepper — the explicitly-
+    sharded engine passes `ring_shard.mapped_step(cfg, mesh)` so studies
+    run on the collective-permute path; metrics stay GSPMD-partitioned.
 
     Per-slot knower COUNTS require unpacking the bit-planes ([N, R] work
     per period), which is fine at study sizes; the throughput bench path
@@ -254,7 +259,10 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
     def body(carry, _):
         st, track = carry
         rnd = ring_mod.draw_period_ring(root_key, st.step, cfg)
-        st = ring_mod.step(cfg, st, plan, rnd)
+        if step_fn is None:
+            st = ring_mod.step(cfg, st, plan, rnd)
+        else:
+            st = step_fn(st, plan, rnd)
         t = st.step - 1
         crashed = t >= plan.crash_step
         up = ~crashed & (t >= plan.join_step)

@@ -90,6 +90,19 @@ class TestBitwiseVsGlobal:
                 err_msg=name)
 
 
+class TestStudyPath:
+    def test_study_matches_ring_engine(self):
+        """experiments --engine ringshard == --engine ring, field for
+        field (the study runner steps through mapped_step)."""
+        from swim_tpu.sim import experiments
+
+        a = experiments.detection_study(n=256, engine="ringshard",
+                                        periods=24)
+        b = experiments.detection_study(n=256, engine="ring", periods=24)
+        a.pop("engine"), b.pop("engine")
+        assert a == b
+
+
 class TestCommunicationPattern:
     def test_no_large_allgathers(self):
         """The step's HLO moves waves with collective-permute; any

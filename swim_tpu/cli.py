@@ -16,6 +16,9 @@ import json
 import sys
 
 
+ENGINES = ("auto", "dense", "rumor", "shard", "ring", "ringshard")
+
+
 def _cmd_info(args: argparse.Namespace) -> int:
     import swim_tpu
 
@@ -254,7 +257,7 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--crash-fraction", type=float, default=0.01)
     sim.add_argument("--suspicion-mult", type=float, default=5.0)
     sim.add_argument("--lifeguard", action="store_true")
-    sim.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring", "ringshard"),
+    sim.add_argument("--engine", choices=ENGINES,
                      default="auto")
     sim.add_argument("--profile", default="",
                      help="write a jax.profiler device trace to this dir")
@@ -267,7 +270,7 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--nodes", type=int, default=1000)
     st.add_argument("--periods", type=int, default=100)
     st.add_argument("--seed", type=int, default=0)
-    st.add_argument("--engine", choices=("auto", "dense", "rumor", "shard", "ring", "ringshard"),
+    st.add_argument("--engine", choices=ENGINES,
                     default="auto")
     st.add_argument("--crash-fraction", type=float, default=0.01)
     st.add_argument("--loss", type=float, default=0.05)

@@ -29,9 +29,11 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(np.asarray(devices), (NODE_AXIS,))
 
 
-def node_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
-    """Shard the leading (node) axis; replicate everything else."""
-    return NamedSharding(mesh, P(NODE_AXIS, *([None] * (ndim - 1))))
+def node_sharding(mesh: Mesh, ndim: int, axis: int = 0) -> NamedSharding:
+    """Shard the node axis (at position `axis`); replicate the rest."""
+    spec = [None] * ndim
+    spec[axis] = NODE_AXIS
+    return NamedSharding(mesh, P(*spec))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -50,29 +52,41 @@ def _node_dim(state, n: int | None) -> int | None:
                 if getattr(x, "ndim", 0) >= 1), default=None)
 
 
-def shard_state(state, mesh: Mesh, n: int | None = None):
-    """Place a per-node-leading-axis state pytree onto the mesh.
+def _spec_fn(state, mesh: Mesh, n: int | None):
+    """Name-aware spec chooser shared by shard_state/state_shardings.
 
-    Arrays whose leading dim equals the node count shard on it; everything
-    else replicates. Works for DenseState, RumorState, and FaultPlan.
-    """
+    Node axis is the leading axis by default; a state NamedTuple class
+    may carry a plain SHARD_AXES class attribute (field name -> axis)
+    for tensors whose node axis is not leading (e.g. the ring engine's
+    word-major `cold`)."""
     nn = _node_dim(state, n)
+    overrides = getattr(type(state), "SHARD_AXES", {})
+    fields = getattr(state, "_fields", ())
 
-    def place(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == nn:
-            return jax.device_put(x, node_sharding(mesh, x.ndim))
-        return jax.device_put(x, replicated(mesh))
+    def spec_of(name, x):
+        axis = overrides.get(name, 0)
+        if (getattr(x, "ndim", 0) > axis and x.shape[axis] == nn):
+            return node_sharding(mesh, x.ndim, axis)
+        return replicated(mesh)
 
-    return jax.tree.map(place, state)
+    if fields:
+        return type(state)(*(spec_of(nm, x)
+                             for nm, x in zip(fields, state)))
+    return jax.tree.map(lambda x: spec_of("", x), state)
+
+
+def shard_state(state, mesh: Mesh, n: int | None = None):
+    """Place a per-node-axis state pytree onto the mesh.
+
+    Arrays whose node axis (leading by default; per-field overrides via
+    the state type's SHARD_AXES) equals the node count shard on it;
+    everything else replicates. Works for DenseState, RumorState,
+    RingState, and FaultPlan.
+    """
+    specs = _spec_fn(state, mesh, n)
+    return jax.tree.map(jax.device_put, state, specs)
 
 
 def state_shardings(state, mesh: Mesh, n: int | None = None):
     """The NamedSharding pytree matching `shard_state` (for jit donation)."""
-    nn = _node_dim(state, n)
-
-    def spec(x):
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == nn:
-            return node_sharding(mesh, x.ndim)
-        return replicated(mesh)
-
-    return jax.tree.map(spec, state)
+    return _spec_fn(state, mesh, n)

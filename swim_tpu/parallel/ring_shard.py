@@ -153,10 +153,11 @@ class ShardOps:
             jnp.where(owned, v, jnp.zeros((), v.dtype)), AXIS)
 
     def knows_words(self, win, cold, slot_pos, rows, slot):
+        # cold is word-major: [RW, local N]
         ok, wcol, word_r, bit = slot_pos(slot)
         lr, owned = self._local(rows)
         lrc = jnp.clip(lr, 0, self.s - 1)
-        word = jnp.where(ok, win[lrc, wcol], cold[lrc, word_r])
+        word = jnp.where(ok, win[lrc, wcol], cold[word_r, lrc])
         kn = (slot >= 0) & (((word >> bit) & 1) > 0)
         return jax.lax.psum(
             jnp.where(owned, kn, False).astype(jnp.int32), AXIS) > 0
@@ -181,7 +182,7 @@ class ShardOps:
 
 def _state_specs(cfg: SwimConfig) -> ring.RingState:
     return ring.RingState(
-        win=P(AXIS, None), cold=P(AXIS, None), inc_self=P(AXIS),
+        win=P(AXIS, None), cold=P(None, AXIS), inc_self=P(AXIS),
         lha=P(AXIS), gone_key=P(AXIS),
         subject=P(), rkey=P(), birth0=P(), sent_node=P(), sent_time=P(),
         confirmed=P(), overflow=P(), index_overflow=P(), step=P())

@@ -25,7 +25,7 @@ def assert_states_equal(orc: ring_oracle.RingOracle, est, t):
     win, cold, win_cols = orc.packed_state()
     np.testing.assert_array_equal(win, np.asarray(est.win),
                                   err_msg=f"win @ period {t}")
-    e_cold = np.asarray(est.cold)
+    e_cold = np.asarray(est.cold).T     # engine cold is word-major
     mask = np.ones(cold.shape[1], bool)
     mask[win_cols] = False
     np.testing.assert_array_equal(cold[:, mask], e_cold[:, mask],

@@ -90,17 +90,9 @@ def fig_detection_cdf():
 
 
 def fp_viewperiods(loss: float, lifeguard: bool) -> int:
-    import jax
+    from tests.test_fidelity import fp_study
 
-    from swim_tpu import SwimConfig
-    from swim_tpu.models import rumor
-    from swim_tpu.sim import faults, runner
-
-    n, periods = 512, 70
-    cfg = SwimConfig(n_nodes=n, lifeguard=lifeguard)
-    plan = faults.with_loss(faults.none(n), loss)
-    res = runner.run_study_rumor(cfg, rumor.init_state(cfg), plan,
-                                 jax.random.key(3), periods)
+    res = fp_study(loss, lifeguard)
     return int(np.asarray(res.series.false_dead_views).sum())
 
 

@@ -61,6 +61,22 @@ def ks_distance_geometric(samples: np.ndarray, p: float) -> float:
     return float(np.abs(emp - geometric_cdf(ks, p)).max())
 
 
+FP_N = 512
+FP_PERIODS = 70
+
+
+def fp_study(loss: float, lifeguard: bool = False):
+    """The FP-suppression experiment (N=512, 70 periods, seed 3) —
+    shared by TestFalsePositiveSuppression and scripts/make_figures.py
+    so the committed fp_suppression.png cannot silently diverge from
+    the CI-enforced measurement."""
+    cfg = SwimConfig(n_nodes=FP_N, lifeguard=lifeguard)
+    plan = faults.with_loss(faults.none(FP_N), loss)
+    state = rumor.init_state(cfg)
+    return runner.run_study_rumor(cfg, state, plan, jax.random.key(3),
+                                  FP_PERIODS)
+
+
 def detection_latencies(n: int, n_crash: int, crash_at: int, periods: int,
                         seed: int) -> np.ndarray:
     """First-suspicion latencies (periods, >=1) for a burst crash of
@@ -135,11 +151,7 @@ class TestFalsePositiveSuppression:
     PERIODS = 70
 
     def _run(self, loss: float, lifeguard: bool = False):
-        cfg = SwimConfig(n_nodes=self.N, lifeguard=lifeguard)
-        plan = faults.with_loss(faults.none(self.N), loss)
-        state = rumor.init_state(cfg)
-        return runner.run_study_rumor(cfg, state, plan, jax.random.key(3),
-                                      self.PERIODS)
+        return fp_study(loss, lifeguard)
 
     def test_fp_suppression_subcritical(self):
         for loss, want_suspicion in ((0.0, False), (0.05, True)):

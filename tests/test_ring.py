@@ -124,6 +124,38 @@ class TestBitwiseVsOracle:
         orc, _ = run_both(cfg, plan, 14, seed=5)
 
 
+class TestConfigSweep:
+    """Bitwise engine/oracle parity across the GEOMETRY space — ring
+    word budget, window length, view-index depth, fan-out, probe mode,
+    lifeguard arm — each under mixed faults (crash + loss + join).  The
+    fixed scenarios above pin behaviors; this sweep pins that the packed
+    layout's slot arithmetic survives every geometry, not just the
+    default one."""
+
+    CONFIGS = [
+        dict(n_nodes=24, ring_orig_words=1, ring_window_periods=2,
+             ring_view_c=2, k_indirect=1),
+        dict(n_nodes=48, ring_orig_words=2, ring_window_periods=3,
+             ring_view_c=2, k_indirect=2, lifeguard=True),
+        dict(n_nodes=48, ring_orig_words=1, ring_window_periods=6,
+             ring_view_c=3, k_indirect=3),
+        dict(n_nodes=96, ring_orig_words=2, ring_window_periods=2,
+             ring_view_c=4, k_indirect=3, max_piggyback=3,
+             lifeguard=True),
+        dict(n_nodes=32, ring_orig_words=3, ring_window_periods=2,
+             ring_view_c=2, k_indirect=1, ring_probe="pull"),
+    ]
+
+    def test_geometry_sweep(self):
+        for i, kw in enumerate(self.CONFIGS):
+            n = kw["n_nodes"]
+            cfg = SwimConfig(**kw)
+            plan = faults.with_loss(faults.none(n), 0.06)
+            plan = faults.with_crashes(plan, [5, n - 3], [2, 6])
+            plan = faults.with_joins(plan, [n - 1], [4])
+            run_both(cfg, plan, 18, seed=10 + i)
+
+
 class TestBehavior:
     """Engine-level protocol behavior (no oracle; bigger N)."""
 

@@ -32,8 +32,9 @@ Tiers (one per engine):
     all-roll waves + bit-packed windowed rumor table.
 
 Run with --smoke for a fast correctness pass (small N, few periods), or
---tier dense|rumor|shard|ring|both|all to pick (default: the headline
-ring tier; "both" = dense + ring, "all" = every engine).
+--tier dense|rumor|shard|ring|ringshard|flagship|both|all to pick
+(default "flagship" = ring + ringshard, the two execution layouts of
+the headline engine; "both" = dense + ring, "all" = every engine).
 """
 
 from __future__ import annotations
@@ -292,9 +293,9 @@ def run_tier(tier: str, platform: str, nodes: int, periods: int,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
-    ap.add_argument("--tier", default="ring",
+    ap.add_argument("--tier", default="flagship",
                     choices=("dense", "rumor", "shard", "ring",
-                             "ringshard", "both", "all"))
+                             "ringshard", "flagship", "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
     ap.add_argument("--platform", default="auto",
@@ -338,7 +339,11 @@ def main() -> int:
         n_d = min(args.nodes or 1024, 2048)
         periods = args.periods or 20
 
-    tiers = {"both": ["dense", "ring"],
+    # flagship (the default) runs both ring execution layouts — on one
+    # real chip they coincide, but on the multi-core CPU fallback the
+    # explicitly-sharded engine uses the 8 virtual devices and wins
+    tiers = {"flagship": ["ring", "ringshard"],
+             "both": ["dense", "ring"],
              "all": ["dense", "rumor", "shard", "ring", "ringshard"]}.get(
         args.tier, [args.tier])
     results = {}

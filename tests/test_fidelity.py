@@ -147,8 +147,8 @@ class TestFalsePositiveSuppression:
     supercritical regime is pinned by the Lifeguard comparison below.
     """
 
-    N = 512
-    PERIODS = 70
+    # experiment knobs live on fp_study (FP_N / FP_PERIODS), shared with
+    # scripts/make_figures.py
 
     def _run(self, loss: float, lifeguard: bool = False):
         return fp_study(loss, lifeguard)

@@ -36,7 +36,10 @@ def probe(timeout: float = 120.0) -> bool:
     try:
         r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
                            capture_output=True, text=True, env=CHILD_ENV)
-        return r.returncode == 0 and "tpu" in r.stdout
+        # The axon plugin may report its platform as "tpu" or "axon"; either
+        # means the tunnel answered and real hardware is reachable.
+        return r.returncode == 0 and any(
+            p in r.stdout for p in ("tpu", "axon"))
     except subprocess.SubprocessError:
         return False
 
@@ -61,6 +64,7 @@ def run_save(name: str, cmd: list[str], timeout: float) -> bool:
     tmp = final + ".tmp"
     with open(tmp, "w") as f:
         json.dump({"cmd": cmd, "rc": r.returncode, "result": payload,
+                   "stdout_tail": (r.stdout or "")[-6000:],
                    "stderr_tail": (r.stderr or "")[-2000:],
                    "captured_at": time.strftime("%Y-%m-%d %H:%M:%S")},
                   f, indent=1)
@@ -70,30 +74,52 @@ def run_save(name: str, cmd: list[str], timeout: float) -> bool:
     return r.returncode == 0 and payload is not None
 
 
+CAPTURES: list[tuple[str, list[str], float, bool]] = [
+    # (name, cmd tail, timeout, required-for-completion)
+    ("bench_all", ["bench.py", "--tier", "all"], 3600, True),
+    # Profile trace: top-op attribution for the optimized ring step.
+    ("profile_ring_1m",
+     ["scripts/profile_ring.py", "1000000", "--periods", "3",
+      "--trace", "/tmp/tr_r3"], 1800, False),
+    # Real λ sweep (BASELINE config 4): 5 multipliers × 2 loss rates = 10
+    # full 1M-node 100-period runs — budget accordingly.
+    ("study_suspicion_1m",
+     ["-m", "swim_tpu.cli", "study", "suspicion_sweep", "--nodes",
+      "1000000", "--engine", "ring", "--periods", "100",
+      "--mults", "1.0", "2.0", "3.0", "4.0", "6.0",
+      "--losses", "0.02", "0.05"], 10800, True),
+    ("study_lifeguard_1m",
+     ["-m", "swim_tpu.cli", "study", "lifeguard", "--nodes", "1000000",
+      "--engine", "ring", "--periods", "100"], 3600, True),
+]
+
+
 def main() -> int:
     max_hours = 12.0
     if "--max-hours" in sys.argv:
         max_hours = float(sys.argv[sys.argv.index("--max-hours") + 1])
     deadline = time.time() + max_hours * 3600
+    done: set[str] = set()
     while time.time() < deadline:
         if probe():
             print("[tpu_watch] TPU healthy — capturing", flush=True)
-            ok = run_save("bench_all",
-                          [sys.executable, "bench.py", "--tier", "all"],
-                          3600)
-            ok &= run_save("study_suspicion_1m", [
-                sys.executable, "-m", "swim_tpu.cli", "study",
-                "suspicion_sweep", "--nodes", "1000000", "--engine",
-                "ring", "--periods", "100", "--mults", "3.0", "5.0"],
-                3600)
-            ok &= run_save("study_lifeguard_1m", [
-                sys.executable, "-m", "swim_tpu.cli", "study",
-                "lifeguard", "--nodes", "1000000", "--engine", "ring",
-                "--periods", "100"], 3600)
-            if ok:
+            for name, tail, tmo, required in CAPTURES:
+                if name in done:
+                    continue
+                if run_save(name, [sys.executable] + tail, tmo) or \
+                        not required:
+                    done.add(name)  # completed (or best-effort) — keep it
+                elif not probe():
+                    # Tunnel died mid-pass: don't burn hours running the
+                    # remaining long captures against a dead backend.
+                    print("[tpu_watch] tunnel lost mid-capture; waiting",
+                          flush=True)
+                    break
+            if {n for n, _, _, req in CAPTURES if req} <= done:
                 print("[tpu_watch] capture complete", flush=True)
                 return 0
-            print("[tpu_watch] bench incomplete; will retry", flush=True)
+            print("[tpu_watch] capture incomplete; will retry the "
+                  "missing pieces", flush=True)
         time.sleep(240)
     print("[tpu_watch] gave up (deadline)", flush=True)
     return 1

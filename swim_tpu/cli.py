@@ -188,12 +188,15 @@ def _cmd_study(args: argparse.Namespace) -> int:
     if args.study == "detection":
         kw["crash_fraction"] = args.crash_fraction
     elif args.study == "fp_sweep":
-        kw["losses"] = tuple(args.losses)
+        if args.losses:
+            kw["losses"] = tuple(args.losses)
         kw["partition"] = not args.no_partition
     elif args.study == "suspicion_sweep":
         kw["mults"] = tuple(args.mults)
         kw["crash_fraction"] = args.crash_fraction
         kw["loss"] = args.loss
+        if args.losses:
+            kw["losses"] = tuple(args.losses)
     elif args.study == "lifeguard":
         kw["crash_fraction"] = args.crash_fraction
         kw["loss"] = args.loss
@@ -274,8 +277,9 @@ def build_parser() -> argparse.ArgumentParser:
                     default="auto")
     st.add_argument("--crash-fraction", type=float, default=0.01)
     st.add_argument("--loss", type=float, default=0.05)
-    st.add_argument("--losses", type=float, nargs="*",
-                    default=[0.0, 0.1, 0.2, 0.3])
+    st.add_argument("--losses", type=float, nargs="*", default=None,
+                    help="loss-rate grid (fp_sweep; also turns "
+                         "suspicion_sweep into a mults x losses grid)")
     st.add_argument("--mults", type=float, nargs="*",
                     default=[2.0, 3.0, 5.0, 8.0])
     st.add_argument("--no-partition", action="store_true")

@@ -137,25 +137,36 @@ def fp_sweep(n: int = 100_000, losses: tuple = (0.0, 0.1, 0.2, 0.3),
 def suspicion_sweep(n: int = 1_000_000,
                     mults: tuple = (2.0, 3.0, 5.0, 8.0),
                     crash_fraction: float = 0.001, loss: float = 0.05,
+                    losses: tuple | None = None,
                     periods: int = 100, seed: int = 0,
                     engine: str = "auto", **cfg_kw) -> dict[str, Any]:
-    """Config 4: suspicion-timeout λ sweep — latency vs FP trade-off."""
+    """Config 4: suspicion-timeout λ sweep — latency vs FP trade-off.
+
+    When `losses` is given the sweep is the full `mults × losses` grid
+    (BASELINE config 4 wants the trade-off curve at more than one packet
+    loss rate); otherwise the single `loss` rate is used.
+    """
     engine = pick_engine(n, engine)
+    grid = tuple(losses) if losses else (loss,)
     points = []
-    for mult in mults:
-        cfg = SwimConfig(n_nodes=n, suspicion_mult=mult, **cfg_kw)
-        plan = faults.with_loss(
-            faults.with_random_crashes(
-                faults.none(n), jax.random.key(seed + 1), crash_fraction,
-                2, max(3, periods // 2)),
-            loss)
-        res = _run_study(cfg, plan, jax.random.key(seed), periods, engine)
-        pt = {"suspicion_mult": mult,
-              "suspicion_periods": cfg.suspicion_periods}
-        pt.update(runner.detection_summary(res, plan, periods))
-        points.append(pt)
+    for lv in grid:
+        for mult in mults:
+            cfg = SwimConfig(n_nodes=n, suspicion_mult=mult, **cfg_kw)
+            plan = faults.with_loss(
+                faults.with_random_crashes(
+                    faults.none(n), jax.random.key(seed + 1), crash_fraction,
+                    2, max(3, periods // 2)),
+                lv)
+            res = _run_study(cfg, plan, jax.random.key(seed), periods,
+                             engine)
+            pt = {"suspicion_mult": mult, "loss": lv,
+                  "suspicion_periods": cfg.suspicion_periods}
+            pt.update(runner.detection_summary(res, plan, periods))
+            pt["false_dead_views_peak"] = int(np.asarray(
+                res.series.false_dead_views).max())
+            points.append(pt)
     return {"study": "suspicion_sweep", "n": n, "periods": periods,
-            "engine": engine, "loss": loss, "points": points}
+            "engine": engine, "losses": list(grid), "points": points}
 
 
 def lifeguard_ablation(n: int = 1_000_000, crash_fraction: float = 0.001,

@@ -15,20 +15,40 @@ conftest) routes through :func:`force_cpu`.
 from __future__ import annotations
 
 import os
+import re
+
+_COUNT_FLAG = r"--xla_force_host_platform_device_count=(\d+)"
+
+
+def virtual_device_count(env: dict | None = None) -> int | None:
+    """The forced host-platform device count in ``XLA_FLAGS``, if any."""
+    m = re.search(_COUNT_FLAG, (env if env is not None else os.environ)
+                  .get("XLA_FLAGS", ""))
+    return int(m.group(1)) if m else None
+
+
+def set_virtual_devices(env: dict, n_devices: int) -> None:
+    """Force exactly `n_devices` virtual CPU devices in ``env``.
+
+    Replaces any existing count flag. Only meaningful before the backend
+    this env feeds is initialized — for ``os.environ`` that means before
+    any jax device query in this process; for a subprocess env dict,
+    before spawning.
+    """
+    flags = re.sub(_COUNT_FLAG, "", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
 
 
 def ensure_virtual_devices(n_devices: int) -> None:
     """Ask XLA's host platform for `n_devices` virtual CPU devices.
 
-    Appends ``--xla_force_host_platform_device_count`` unless some count is
-    already configured (first writer wins — changing it after a backend
-    exists has no effect anyway).
+    First writer wins: a count already configured is left alone (changing
+    it after a backend exists has no effect anyway).
     """
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n_devices}"
-        ).strip()
+    if virtual_device_count() is None:
+        set_virtual_devices(os.environ, n_devices)
 
 
 def force_cpu(n_devices: int | None = None) -> None:

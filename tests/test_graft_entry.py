@@ -1,0 +1,32 @@
+"""Guards on the driver's official entry points (__graft_entry__.py).
+
+MULTICHIP_r01/r02 both went red on environmental grounds (a hung TPU
+backend initialized in the capture process).  These tests pin the two
+defenses: the dry run re-execs itself in a scrubbed subprocess whenever
+the calling process is not pristine, and the whole thing stays well
+under typical driver timeouts.
+"""
+from __future__ import annotations
+
+import time
+
+import __graft_entry__ as ge
+
+
+def test_dryrun_reexecs_and_finishes_fast():
+    # The pytest process has long since initialized the (CPU) backend, so
+    # this exercises the production defense path end-to-end: detect the
+    # initialized backend, re-exec the body in a scrubbed subprocess.
+    assert ge._backend_initialized()
+    t0 = time.monotonic()
+    ge.dryrun_multichip(8)
+    elapsed = time.monotonic() - t0
+    # Driver timeouts killed r01/r02 at ~240 s; budget the full dryrun
+    # (subprocess spawn + imports + ring-pair compile + 1 period) at 90 s
+    # so a compile-time regression is caught a round before it hurts.
+    assert elapsed < 90.0, f"dryrun took {elapsed:.1f}s (budget 90s)"
+
+
+def test_entry_shapes():
+    fn, args = ge.entry()
+    assert callable(fn) and len(args) == 3

@@ -259,3 +259,27 @@ class TestShardedExecution:
         ref = rumor.step(cfg, rumor.init_state(cfg), plan, rnd)
         for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dense_step_on_virtual_mesh(self):
+        # GSPMD placement of the dense engine (this coverage used to live
+        # in __graft_entry__.dryrun_multichip; the dryrun is now slimmed
+        # to the flagship ring pair).
+        import functools
+
+        from swim_tpu.parallel import mesh as pmesh
+        from swim_tpu.utils import prng
+
+        n = 64
+        cfg = SwimConfig(n_nodes=n)
+        mesh = pmesh.make_mesh(8)
+        plan = pmesh.shard_state(
+            faults.with_crashes(faults.none(n), [3], [0]), mesh, n=n)
+        st = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
+        step = jax.jit(functools.partial(dense.step, cfg),
+                       out_shardings=pmesh.state_shardings(st, mesh, n=n))
+        rnd = prng.draw_period(jax.random.key(0), 0, cfg)
+        out = step(st, plan, rnd)
+        assert int(out.step) == 1
+        ref = dense.step(cfg, dense.init_state(cfg), plan, rnd)
+        for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -243,9 +243,28 @@ def run_tier_child(args) -> int:
     # else ("default"/"auto"): leave the ambient platform alone.
     try:
         pps = TIER_FNS[args._tier](args.nodes, args.periods)
-        print(json.dumps({"ok": True, "tier": args._tier,
-                          "nodes": args.nodes, "periods": args.periods,
-                          "periods_per_sec": round(pps, 2)}))
+        out = {"ok": True, "tier": args._tier,
+               "nodes": args.nodes, "periods": args.periods,
+               "periods_per_sec": round(pps, 2)}
+        if args._tier in ("ring", "ringshard"):
+            # Self-describing headline (VERDICT r2 task 7): report probe
+            # mode and the HBM roofline band so a green number can never
+            # hide a rotor-vs-pull or CPU-vs-TPU apples-to-oranges read.
+            import jax
+
+            from swim_tpu import SwimConfig
+            from swim_tpu.utils import roofline as rl
+
+            cfg = SwimConfig(n_nodes=args.nodes)
+            ceil = rl.ceiling_periods_per_sec(cfg)
+            out["devices"] = len(jax.devices())
+            out["ring_probe"] = cfg.ring_probe
+            out["v5e_chip_ceiling_pps"] = [
+                round(ceil["ceiling_unfused"], 1),
+                round(ceil["ceiling_fused"], 1)]
+            out["bytes_per_period"] = [
+                int(ceil["bytes_unfused"]), int(ceil["bytes_fused"])]
+        print(json.dumps(out))
         return 0
     except Exception as e:  # noqa: BLE001 — the whole point is containment
         print(json.dumps({"ok": False, "tier": args._tier,
@@ -368,8 +387,10 @@ def main() -> int:
         head, head_tier = results["dense"], "dense"
     if head is not None:
         value = head["periods_per_sec"]
+        probe_txt = (f"{head['ring_probe']} probe, "
+                     if head.get("ring_probe") else "")
         metric = (f"simulated protocol-periods/sec @ {head['nodes']} nodes "
-                  f"({head_tier} engine, {platform})")
+                  f"({head_tier} engine, {probe_txt}{platform})")
     else:
         value = 0.0
         metric = f"simulated protocol-periods/sec (all tiers failed, {platform})"
@@ -382,6 +403,17 @@ def main() -> int:
         "vs_baseline": round(value / TARGET_PERIODS_PER_SEC, 4),
         "platform": platform,
     }
+    if head is not None and head.get("v5e_chip_ceiling_pps"):
+        out["ring_probe"] = head["ring_probe"]
+        out["v5e_chip_ceiling_pps"] = head["v5e_chip_ceiling_pps"]
+        out["bytes_per_period"] = head["bytes_per_period"]
+        if on_tpu:
+            # fraction of the HBM roofline actually achieved on the mesh
+            # the tier ran on (fused-traffic bracket — the harder target;
+            # the ceiling scales with device count under node sharding)
+            out["roofline_fraction"] = round(
+                value / (head["v5e_chip_ceiling_pps"][1]
+                         * max(head.get("devices", 1), 1)), 4)
     for tier, r in results.items():
         if r.get("ok"):
             out[f"{tier}_nodes"] = r["nodes"]

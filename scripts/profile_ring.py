@@ -51,14 +51,26 @@ key = jax.random.key(0)
 
 run = jax.jit(lambda st: ring.run(cfg, st, plan, key, periods))
 t0 = time.perf_counter()
-out = jax.block_until_ready(run(state))
-print(f"compile+first: {time.perf_counter() - t0:.2f}s "
+compiled = run.lower(state).compile()
+print(f"compile: {time.perf_counter() - t0:.2f}s "
       f"(platform={jax.devices()[0].platform})")
+out = jax.block_until_ready(compiled(state))
 t0 = time.perf_counter()
-out = jax.block_until_ready(run(state))
+out = jax.block_until_ready(compiled(state))
 dt = time.perf_counter() - t0
 print(f"{periods} periods: {dt:.3f}s -> {dt / periods * 1e3:.1f} ms/period, "
       f"{periods / dt:.2f} periods/sec @ N={n} probe={probe}")
+
+# roofline cross-check: the analytic traffic model vs XLA's own
+# bytes-accessed estimate for the whole compiled run (when exposed)
+from swim_tpu.utils import roofline as rl
+
+tr_model = rl.ring_traffic(cfg)
+xla_bytes = rl.hlo_bytes_accessed(compiled)
+print(f"roofline model: {tr_model['fused'] / 1e9:.2f}-"
+      f"{tr_model['unfused'] / 1e9:.2f} GB/period"
+      + (f"; XLA cost-analysis: {xla_bytes / periods / 1e9:.2f} GB/period"
+         if xla_bytes else "; XLA cost-analysis: n/a on this backend"))
 
 if not trace_dir:
     sys.exit(0)

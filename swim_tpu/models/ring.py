@@ -815,9 +815,13 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # oracle's np.float32 divide by construction.
         import numpy as _np
 
+        # Exact integer ramp rounded ONCE to f32 — float-dtype arange is
+        # inexact above 2^24 and would silently diverge from the oracle's
+        # np.float32(int) rounding at that scale; int64→f32 cast matches
+        # it for every i.
+        _ramp = _np.arange(n, dtype=_np.int64).astype(_np.float32)
         recip = jnp.asarray(
-            _np.float32(1.0)
-            / _np.maximum(_np.arange(n, dtype=_np.float32), 1.0))
+            _np.float32(1.0) / _np.maximum(_ramp, _np.float32(1.0)))
         di = jnp.clip(members - 1, 1, n - 1)
         base = jnp.float32(1.0) - recip[di]
         p0 = jnp.where(members >= 2, pow_f32(base, jnp.maximum(lj, 0)),

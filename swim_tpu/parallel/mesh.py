@@ -45,9 +45,18 @@ def _node_dim(state, n: int | None) -> int | None:
 
     Pass `n` explicitly for states whose replicated tables can be longer
     than the node axis (e.g. a RumorState with rumor_slots > n_nodes).
+    States with non-leading node axes (SHARD_AXES) *require* it: their
+    replicated tables ([R]) or word-major matrices ([RW, N]) can exceed N
+    at small N, and the largest-leading-dim inference would silently
+    mis-shard them.
     """
     if n is not None:
         return n
+    if getattr(type(state), "SHARD_AXES", None):
+        raise ValueError(
+            f"shard_state/state_shardings: pass n= explicitly for "
+            f"{type(state).__name__} (it declares SHARD_AXES; inferring "
+            f"the node axis from the largest leading dim can mis-shard)")
     return max((x.shape[0] for x in jax.tree.leaves(state)
                 if getattr(x, "ndim", 0) >= 1), default=None)
 

@@ -68,6 +68,12 @@ from swim_tpu.sim.faults import FaultPlan
 
 AXIS = pmesh.NODE_AXIS
 
+# Audit mode for the roll_from replicated-shift invariant (see its
+# docstring): when True, every roll prints the cross-shard spread of its
+# shift, which must be 0.  Costs a pmax+pmin+host-callback per roll —
+# debug only.
+DEBUG_REPLICATED = False
+
 
 class ShardOps:
     """ring.GlobalOps twin for one node-axis shard inside shard_map.
@@ -113,8 +119,22 @@ class ShardOps:
         """x at global node (i + d) mod n for my rows i: d = k·S + r, so
         the answer is rows [r, S) of shard me+k plus rows [0, r) of
         shard me+k+1 — two ppermutes (switch-selected static k) and one
-        dynamic slice."""
+        dynamic slice.
+
+        INVARIANT: `d` must be REPLICATED across shards (identical traced
+        value on every shard). The lax.switch selects which ppermute
+        branch runs, and collectives must be entered by all shards in the
+        same order — a per-shard-divergent `d` would desynchronize them
+        (hang or silent corruption), and shard_map's check_rep=False means
+        nothing verifies this at trace time. All current callers derive
+        `d` from `rnd.*` fields, which place() replicates by construction.
+        Set DEBUG_REPLICATED=True to audit the invariant at runtime (the
+        printed spread must be 0 on every call)."""
         dd = jnp.mod(jnp.asarray(d, jnp.int32), self.n)
+        if DEBUG_REPLICATED:
+            spread = (jax.lax.pmax(dd, AXIS) - jax.lax.pmin(dd, AXIS))
+            jax.debug.print("roll_from shift spread (must be 0): {s}",
+                            s=spread)
         k = dd // self.s
         r = jnp.mod(dd, self.s)
         a = jax.lax.switch(

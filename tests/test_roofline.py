@@ -1,0 +1,38 @@
+"""The roofline accounting must stay consistent with the engine geometry."""
+from __future__ import annotations
+
+from swim_tpu import SwimConfig
+from swim_tpu.utils import roofline as rl
+
+
+def test_traffic_terms_and_brackets():
+    cfg = SwimConfig(n_nodes=65_536)
+    tr = rl.ring_traffic(cfg)
+    assert tr["waves"] == 2 + 4 * cfg.k_indirect
+    # every term's fused estimate must not exceed its unfused one
+    for name, (fused, unfused) in tr["terms"].items():
+        assert 0 <= fused <= unfused, name
+    assert tr["fused"] <= tr["unfused"]
+    # the waves term must dominate (that is the documented finding)
+    assert tr["terms"]["waves"][0] > 0.5 * tr["fused"]
+
+
+def test_ceiling_scales_with_devices():
+    cfg = SwimConfig(n_nodes=1_000_000)
+    one = rl.ceiling_periods_per_sec(cfg)
+    eight = rl.ceiling_periods_per_sec(cfg, n_devices=8)
+    assert abs(eight["ceiling_fused"] / one["ceiling_fused"] - 8) < 1e-6
+    # the documented round-3 numbers: single-chip fused ceiling is a few
+    # hundred p/s — if geometry defaults change, RESULTS.md §1a is stale
+    assert 100 < one["ceiling_fused"] < 500
+
+
+def test_traffic_scales_linearly_in_n():
+    # geometry words grow slightly with log10(N) (rw: 108 -> 116 here),
+    # but the dominant waves term depends only on N*WW, so doubling N
+    # must land very near 2x total traffic
+    a = rl.ring_traffic(SwimConfig(n_nodes=100_000))
+    b = rl.ring_traffic(SwimConfig(n_nodes=200_000))
+    assert a["ww"] == b["ww"]
+    assert 1.95 < b["fused"] / a["fused"] < 2.15
+    assert 1.95 < b["unfused"] / a["unfused"] < 2.15

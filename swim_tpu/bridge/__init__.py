@@ -9,6 +9,8 @@ the Haskell co-process exists (client.py). SURVEY.md §2 "Host bridge",
 """
 
 from swim_tpu.bridge.client import BridgeTransport, ExternalNodeHost
+from swim_tpu.bridge.engine_server import EngineBridgeServer
 from swim_tpu.bridge.server import BridgeServer
 
-__all__ = ["BridgeServer", "BridgeTransport", "ExternalNodeHost"]
+__all__ = ["BridgeServer", "BridgeTransport", "EngineBridgeServer",
+           "ExternalNodeHost"]

@@ -1,0 +1,462 @@
+"""Engine-backed bridge: a foreign core's peers ARE the tensor simulation.
+
+`EngineBridgeServer` completes the TPUSimTransport seam (SURVEY.md §2
+"Host bridge"; VERDICT r2 "Missing #3"): where `bridge/server.py` hosts
+an event-driven cluster of real `core/node.py` nodes, this server hosts
+an N-node RING-ENGINE simulation (swim_tpu/models/ring.py) and couples
+ONE externally-driven node id to it over the existing lockstep TCP
+protocol (bridge/protocol.py) — so an untouched foreign SWIM core (e.g.
+swim_tpu/native/bridge_client.cpp) probes, gossips with, and detects
+failures among tens of thousands of tensor-simulated peers.
+
+The seam, per protocol period (one `STEP` accumulation of cfg.protocol_period):
+
+  outbound (engine → core): the reserved row X is the core's SHADOW in
+    tensor state.  After each period the server diffs X's resolved
+    heard-bits, decodes the newly-heard ring slots through the rumor
+    table, and DELIVERs them as the piggyback of the ping that the
+    rotor prober (X − s_t) actually sent X inside the engine — the wire
+    traffic mirrors the tensor wave that carried the bits.
+  inbound (core → engine): every datagram the core SENDs is decoded
+    (swim_tpu/core/codec.py); its gossip updates become Phase-D
+    external originations (`ring.ExtOriginations`) with the datagram's
+    receiving engine node as the hearer, so the core's claims — its
+    suspicions, its refutations — radiate through tensor state from
+    the true delivery point.  Pings/ping-reqs are answered immediately
+    from engine state (alive target → synthesized ack carrying the
+    target's actual transmissible window selection).
+  liveness: the engine's view of X is gated on the core really
+    answering the mirrored probes: no ack for `ack_grace` periods →
+    crash_step[X] = now, and the engine detects the silent core
+    organically (suspicion → confirm → dissemination).
+
+Deviations (documented; the seam is a transport, not a re-simulation):
+  D1. Row X keeps its mechanical engine behavior (rotor probing, window
+      recycling); the core's own agency enters as ADDITIONAL forced
+      originations. A fully externally-computed X would need per-wave
+      extraction, which the lockstep protocol's datagram granularity
+      cannot express.
+  D2. An injected update whose rumor already exists in the table dedups
+      onto the existing slot without setting the hearer's bit (it hears
+      through normal waves); stale updates (key ≤ the table's best for
+      that subject, or ≤ the tombstone floor) are dropped host-side.
+  D3. Client-facing replies (acks, join snapshot) are synthesized from
+      engine state at datagram time, not queued to period boundaries —
+      the core's sub-period probe timers (e.g. 0.3·period) would
+      otherwise time out by construction.
+  D4. Wire loss: every core→engine datagram leg (and each synthesized
+      reply leg) draws Bernoulli(loss) from a seeded host RNG, so the
+      core experiences the configured loss rate like any engine wave.
+      Mirrored pings deliver losslessly: their piggyback content
+      already passed the engine's in-wave loss draws, and a second
+      draw would double-count; a lost mirrored-ACK (core→engine) is
+      how the core gets organically suspected under loss.
+
+Reference parity: jpfuentes2/swim's transport seam is its socket layer
+(SURVEY.md §1, tree unavailable — §0); this is the TPU-native analog,
+with the simulated side an XLA program instead of a process pool.
+"""
+
+from __future__ import annotations
+
+import functools
+import socket
+import threading
+
+import numpy as np
+
+from swim_tpu.bridge import protocol as bp
+from swim_tpu.config import SwimConfig
+from swim_tpu.core import codec
+from swim_tpu.types import (MsgKind, Status, key_incarnation, key_status,
+                            opinion_key)
+
+WORD = 32
+
+
+def _status_of(key: int) -> Status:
+    return Status(key_status(key))
+
+
+def _inc_of(key: int) -> int:
+    return key_incarnation(key)
+
+
+def _pack_key(status: Status, inc: int) -> int:
+    # types.opinion_key clamps inc to INC_MAX — essential here: a hostile
+    # or corrupt wire incarnation >= 2^30 would otherwise shift into the
+    # sticky DEAD bit and falsely tombstone an arbitrary member
+    return opinion_key(int(status), inc)
+
+
+class EngineBridgeServer:
+    """Single-client lockstep server over a ring-engine simulation."""
+
+    def __init__(self, cfg: SwimConfig, external_id: int, seed: int = 0,
+                 host: str = "127.0.0.1", port: int = 0,
+                 ext_capacity: int = 16, ack_grace: int = 3,
+                 join_sample: int = 128):
+        import jax
+
+        from swim_tpu.models import ring
+
+        if cfg.ring_probe != "rotor":
+            raise ValueError("EngineBridgeServer requires the rotor probe "
+                             "(the mirrored-ping seam is rotor-shaped)")
+        self.cfg = cfg
+        self.n = cfg.n_nodes
+        if not 0 <= external_id < self.n:
+            raise ValueError("external_id must be one of the N node ids")
+        self.x = external_id
+        self.ext_capacity = ext_capacity
+        self.ack_grace = ack_grace
+        self.join_sample = join_sample
+        self._jax = jax
+        self._ring = ring
+        self._key = jax.random.key(seed)
+        self.state = ring.init_state(cfg)
+        self.t = 0                       # completed protocol periods
+        self._frac = 0.0                 # virtual time into the period
+        # host-side fault mirrors (device plan rebuilt on change)
+        self._crash = np.full((self.n,), np.iinfo(np.int32).max // 2,
+                              np.int32)
+        self._join = np.zeros((self.n,), np.int32)
+        self._loss = 0.0
+        self._plan = None
+        self._plan_dirty = True
+        self._step = jax.jit(functools.partial(ring.step, cfg))
+        # injections queued for the next period boundary
+        self._inject: list[tuple[int, int, int, int]] = []  # subj,key,org,hear
+        self._rng = np.random.default_rng(seed * 7919 + 17)  # D4 wire loss
+        # host mirrors of the rumor table (refreshed after every period)
+        self._subject = np.asarray(self.state.subject)
+        self._rkey = np.asarray(self.state.rkey)
+        self._gone = np.asarray(self.state.gone_key)
+        self._prev_row = self._resolved_row(self.x)
+        self._last_ack = -1              # newest mirrored-ping period acked
+        self._joined = False
+        self._x_crashed = False
+        self._outq: list[bp.Frame] = []
+        self._lock = threading.Lock()    # guards _outq/_inject/_crash
+        #                                  (test hooks run off-thread)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(1)
+        self.address = self._sock.getsockname()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: float = 300.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _serve(self) -> None:
+        try:
+            conn, _ = self._sock.accept()
+        except OSError:
+            return
+        try:
+            while True:
+                f = bp.read_frame(conn)
+                if f is None or f.op == bp.BYE:
+                    return
+                self._handle(conn, f)
+        except (ValueError, OSError):
+            return
+        finally:
+            conn.close()
+            self._sock.close()
+
+    # ------------------------------------------------------------- protocol
+
+    def _now(self) -> float:
+        return self.t * self.cfg.protocol_period + self._frac
+
+    def _handle(self, conn: socket.socket, f: bp.Frame) -> None:
+        if f.op == bp.HELLO:
+            if f.a != self.x or self._joined:
+                bp.write_frame(conn, bp.Frame(bp.ERROR, a=bp.ERR_ID_TAKEN))
+                return
+            self._joined = True
+            self._last_ack = self.t  # grace starts at join
+            bp.write_frame(conn, bp.Frame(bp.WELCOME, a=f.a, t=self._now()))
+        elif f.op == bp.SEND:
+            self._on_datagram(f.a, f.b, f.payload)
+        elif f.op == bp.STEP:
+            self._frac += f.t
+            while self._frac >= self.cfg.protocol_period - 1e-9:
+                self._frac -= self.cfg.protocol_period
+                self._run_period()
+            with self._lock:
+                flush, self._outq = self._outq, []
+            for fr in flush:
+                bp.write_frame(conn, fr)
+            bp.write_frame(conn, bp.Frame(bp.TIME, t=self._now()))
+        elif f.op == bp.KILL:
+            self.kill(f.a)
+        elif f.op == bp.SET_LOSS:
+            self._loss = float(f.t)
+            self._plan_dirty = True
+
+    # --------------------------------------------------------- fault wiring
+
+    def kill(self, node_id: int) -> None:
+        with self._lock:
+            if 0 <= node_id < self.n and self._crash[node_id] > self.t:
+                self._crash[node_id] = self.t
+                self._plan_dirty = True
+
+    def _alive(self, node_id: int) -> bool:
+        return (0 <= node_id < self.n and self._crash[node_id] > self.t
+                and self._join[node_id] <= self.t)
+
+    def _device_plan(self):
+        if self._plan_dirty or self._plan is None:
+            import jax.numpy as jnp
+
+            from swim_tpu.sim.faults import FaultPlan
+
+            self._plan = FaultPlan(
+                crash_step=jnp.asarray(self._crash),
+                loss=jnp.float32(self._loss),
+                partition_id=jnp.zeros((self.n,), jnp.int32),
+                partition_start=jnp.int32(1 << 30),
+                partition_end=jnp.int32(1 << 30),
+                join_step=jnp.asarray(self._join))
+            self._plan_dirty = False
+        return self._plan
+
+    # -------------------------------------------------------- inbound seam
+
+    def _queue_injections(self, hearer: int,
+                          gossip: tuple[codec.WireUpdate, ...]) -> None:
+        for u in gossip:
+            if not 0 <= u.member < self.n:
+                continue
+            key = _pack_key(u.status, u.incarnation)
+            if key <= self._best_key(u.member):
+                continue                 # stale vs table/tombstone (D2)
+            org = u.origin if 0 <= u.origin < self.n else hearer
+            with self._lock:
+                self._inject.append((u.member, key, org, hearer))
+
+    def _lost(self) -> bool:
+        """Bernoulli loss draw for one bridge datagram leg (D4): the
+        core's wire traffic experiences the configured loss rate like
+        any engine wave (seeded host RNG — reproducible given the same
+        datagram order)."""
+        return self._loss > 0.0 and self._rng.random() < self._loss
+
+    def _on_datagram(self, src: int, dst: int, payload: bytes) -> None:
+        if src != self.x:
+            return
+        try:
+            msg = codec.decode(payload)
+        except codec.DecodeError:
+            return
+        if not self._alive(dst) or self._lost():
+            return     # datagram to a dead node, or lost on the wire:
+            #            nothing is heard and nothing replies (D4)
+        self._queue_injections(dst, msg.gossip)
+        if msg.kind == MsgKind.PING:
+            if self._lost():             # ack leg draws its own loss
+                return
+            ack = codec.Message(kind=MsgKind.ACK, sender=dst,
+                                probe_seq=msg.probe_seq,
+                                on_behalf=msg.on_behalf,
+                                gossip=self._transmissible(dst))
+            self._deliver(dst, ack)
+        elif msg.kind == MsgKind.PING_REQ:
+            tgt = msg.target
+            # proxy round-trip: two more legs (proxy->tgt, tgt->proxy)
+            # plus the relay ack leg, each drawing loss
+            if (self._alive(tgt) and not self._lost()
+                    and not self._lost() and not self._lost()):
+                ack = codec.Message(kind=MsgKind.ACK, sender=dst,
+                                    probe_seq=msg.probe_seq,
+                                    on_behalf=tgt,
+                                    gossip=self._transmissible(tgt))
+                self._deliver(dst, ack)
+        elif msg.kind == MsgKind.ACK:
+            self._last_ack = self.t      # the core answered a mirrored ping
+        elif msg.kind == MsgKind.JOIN:
+            self._deliver(dst, codec.Message(
+                kind=MsgKind.JOIN_REPLY, sender=dst,
+                gossip=self._join_snapshot()))
+
+    def _deliver(self, sender: int, msg: codec.Message) -> None:
+        with self._lock:
+            self._outq.append(bp.Frame(bp.DELIVER, a=sender, b=self.x,
+                                       payload=codec.encode(msg)))
+
+    # -------------------------------------------------------- outbound seam
+
+    def _run_period(self) -> None:
+        import jax
+
+        from swim_tpu.models import ring
+
+        # liveness gate: a silent core is a crashed member
+        if (self._joined and not self._x_crashed
+                and self.t - self._last_ack > self.ack_grace):
+            self.kill(self.x)
+            self._x_crashed = True
+        ext = ring.ext_none(self.ext_capacity)
+        with self._lock:
+            batch, self._inject = (self._inject[:self.ext_capacity],
+                                   self._inject[self.ext_capacity:])
+        if batch:
+            import jax.numpy as jnp
+
+            ext = ring.ExtOriginations(
+                subject=jnp.asarray(
+                    [b[0] for b in batch]
+                    + [-1] * (self.ext_capacity - len(batch)), jnp.int32),
+                key=jnp.asarray(
+                    [b[1] for b in batch]
+                    + [0] * (self.ext_capacity - len(batch)), jnp.uint32),
+                origin=jnp.asarray(
+                    [b[2] for b in batch]
+                    + [0] * (self.ext_capacity - len(batch)), jnp.int32),
+                hearer=jnp.asarray(
+                    [b[3] for b in batch]
+                    + [0] * (self.ext_capacity - len(batch)), jnp.int32))
+        rnd = self._ring.draw_period_ring(self._key, self.t, self.cfg)
+        self.state = self._step(self.state, self._device_plan(), rnd,
+                                ext=ext)
+        s_off = int(jax.device_get(rnd.s_off))
+        self.t += 1
+        # refresh table mirrors, then mirror the rotor probe of X
+        self._subject = np.asarray(self.state.subject)
+        self._rkey = np.asarray(self.state.rkey)
+        self._gone = np.asarray(self.state.gone_key)
+        row = self._resolved_row(self.x)
+        fresh = row & ~self._prev_row
+        self._prev_row = row
+        if not self._joined:
+            return
+        prober = (self.x - s_off) % self.n
+        if not self._alive(prober):
+            return                       # no probe of X this period
+        updates = self._slots_to_updates(np.nonzero(fresh)[0], prober)
+        for chunk in range(0, max(len(updates), 1), 255):
+            ping = codec.Message(kind=MsgKind.PING, sender=prober,
+                                 probe_seq=self.t,
+                                 gossip=tuple(updates[chunk:chunk + 255]))
+            self._deliver(prober, ping)
+
+    # ------------------------------------------------------- state decoding
+
+    def _geom(self):
+        return self._ring.geometry(self.cfg)
+
+    def _resolved_row(self, x: int) -> np.ndarray:
+        """bool[R]: node x's current heard-bits (host mirror of
+        ring.resolved_words for a single node)."""
+        g = self._geom()
+        win_x = np.asarray(self.state.win[x])          # u32[WW]
+        cold_x = np.asarray(self.state.cold[:, x])     # u32[RW]
+        t = int(self.state.step)
+        first_gw = t * g.ow - g.ww
+        win_ring0 = first_gw % g.rw
+        words = cold_x.copy()
+        for w in range(g.ww):
+            words[(win_ring0 + w) % g.rw] = win_x[w]
+        bits = np.unpackbits(
+            words.astype("<u4").view(np.uint8), bitorder="little")
+        return bits.astype(bool)
+
+    def _best_key(self, member: int) -> int:
+        """The strongest table/tombstone key currently held for member
+        (numpy mirrors only — this runs per gossip update on the
+        datagram hot path; a device gather here would cost hundreds of
+        host round-trips per datagram)."""
+        mask = self._subject == member
+        best = int(self._rkey[mask].max()) if mask.any() else 0
+        return max(best, int(self._gone[member]))
+
+    def _slots_to_updates(self, slots: np.ndarray,
+                          origin: int) -> list[codec.WireUpdate]:
+        out = []
+        for sl in slots.tolist():
+            subj = int(self._subject[sl])
+            if subj < 0:
+                continue
+            key = int(self._rkey[sl])
+            out.append(codec.WireUpdate(
+                member=subj, status=_status_of(key), incarnation=_inc_of(key),
+                addr=("sim", subj), origin=origin))
+        return out
+
+    def _transmissible(self, j: int) -> tuple[codec.WireUpdate, ...]:
+        """Node j's current piggyback: up to B used slots of its window
+        (host mirror of the engine's first-B window selection)."""
+        g = self._geom()
+        win_j = np.asarray(self.state.win[j])          # u32[WW]
+        t = int(self.state.step)
+        first_gw = t * g.ow - g.ww
+        r_tot = g.rw * WORD
+        out = []
+        b = min(self.cfg.max_piggyback, g.ww * WORD)
+        for w in range(g.ww - 1, -1, -1):              # newest word first
+            word = int(win_j[w])
+            while word and len(out) < b:
+                bit = (word & -word).bit_length() - 1
+                word &= word - 1
+                sl = (((first_gw + w) % g.rw) * WORD + bit) % r_tot
+                subj = int(self._subject[sl])
+                if subj < 0:
+                    continue
+                key = int(self._rkey[sl])
+                out.append(codec.WireUpdate(
+                    member=subj, status=_status_of(key),
+                    incarnation=_inc_of(key), addr=("sim", subj), origin=j))
+            if len(out) >= b:
+                break
+        return tuple(out)
+
+    def _join_snapshot(self) -> tuple[codec.WireUpdate, ...]:
+        """Up to `join_sample` alive members, spread across the id space
+        (the wire gossip count is u8 — a 64k snapshot cannot fit, and
+        SWIM only needs a partial view to bootstrap probing)."""
+        stride = max(1, self.n // self.join_sample)
+        out = []
+        for m in range(0, self.n, stride):
+            if m != self.x and self._alive(m):
+                out.append(codec.WireUpdate(
+                    member=m, status=Status.ALIVE, incarnation=0,
+                    addr=("sim", m), origin=m))
+            if len(out) >= min(self.join_sample, 255):
+                break
+        return tuple(out)
+
+    # ------------------------------------------------------------ test hooks
+
+    def inject_update(self, subject: int, status: Status, inc: int,
+                      origin: int, hearer: int) -> None:
+        """Queue a rumor injection directly (bypasses the wire)."""
+        with self._lock:
+            self._inject.append(
+                (subject, _pack_key(status, inc), origin, hearer))
+
+    def deliver_forged(self, sender: int,
+                       updates: list[codec.WireUpdate]) -> None:
+        """DELIVER a forged gossip-bearing ping to the core WITHOUT
+        touching tensor state.  Test use: forge suspect(X) on the wire
+        only — the engine's shadow row never sees a suspicion, so any
+        alive(X, inc≥1) that later appears in tensor state can ONLY be
+        the foreign core's refutation arriving through the injection
+        seam (the engine-side proof is inc_self[X] staying 0)."""
+        self._deliver(sender, codec.Message(
+            kind=MsgKind.PING, sender=sender, probe_seq=0,
+            gossip=tuple(updates)))
+
+    def table_keys(self, subject: int) -> list[int]:
+        """All live table keys about `subject` (host mirror)."""
+        return [int(k) for k in self._rkey[self._subject == subject]]

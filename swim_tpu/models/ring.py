@@ -199,6 +199,47 @@ def init_state(cfg: SwimConfig) -> RingState:
 PULL_SRC_ATTEMPTS = 3
 
 
+class ExtOriginations(NamedTuple):
+    """External rumor originations injected into Phase D (host bridge).
+
+    The TPUSimTransport seam (swim_tpu/bridge/engine_server.py): claims
+    arriving from a foreign core over the TCP bridge become first-class
+    rumors in tensor state.  All arrays are replicated, fixed-size [E]:
+
+      subject: i32[E]  member the claim is about (-1 = empty entry)
+      key:     u32[E]  packed opinion key (ops/lattice.py layout)
+      origin:  i32[E]  the claim's ORIGINATOR (wire `origin`: the
+                       suspecting/declaring node — sentinel bookkeeping
+                       tracks its liveness, exactly like internal
+                       suspicions)
+      hearer:  i32[E]  the engine node that RECEIVED the datagram — it
+                       gets the heard-bit, so dissemination radiates
+                       from the true delivery point
+
+    Injected candidates join the Phase-D merge at the LOWEST priority
+    (confirms > refutes > internal suspicions > external): an external
+    claim never displaces an internal origination from the lane budget.
+    Entries whose rumor already exists in the table dedup onto the
+    existing slot (the hearer's bit is then NOT set — it will hear
+    through normal waves; documented deviation of the seam).
+    """
+
+    subject: jax.Array
+    key: jax.Array
+    origin: jax.Array
+    hearer: jax.Array
+
+
+def ext_none(capacity: int) -> ExtOriginations:
+    """An all-empty injection batch of the given static capacity."""
+    return ExtOriginations(
+        subject=jnp.full((capacity,), -1, jnp.int32),
+        key=jnp.zeros((capacity,), jnp.uint32),
+        origin=jnp.zeros((capacity,), jnp.int32),
+        hearer=jnp.zeros((capacity,), jnp.int32),
+    )
+
+
 def pow_f32(base, expo):
     """base**expo for f32 base and non-negative i32 expo, by 31 rounds of
     square-and-multiply in a FIXED operation order.  IEEE-754 f32
@@ -457,12 +498,17 @@ class GlobalOps:
 
 
 def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
-         rnd: RingRandomness, ops: GlobalOps | None = None) -> RingState:
+         rnd: RingRandomness, ops: GlobalOps | None = None,
+         ext: ExtOriginations | None = None) -> RingState:
     """One protocol period for all N nodes (pure; jit with cfg static).
 
     With the default `ops`, every array spans the full node axis; under
     swim_tpu/parallel/ring_shard.py the same body runs inside shard_map
     with node-axis tensors sharded and `ops` supplying the collectives.
+
+    `ext` (optional, static presence) injects externally-originated
+    rumors into Phase D — the host-bridge seam (see ExtOriginations).
+    With ext=None the traced program is unchanged.
     """
     if ops is None:
         ops = GlobalOps(cfg)
@@ -944,7 +990,8 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # is what lets the sharded ops find its node-axis candidates with
     # one small all-gather instead of a global scatter.
     suspect = mk_suspect | re_suspect
-    m_cand = r_tot + 2 * n
+    n_ext = 0 if ext is None else ext.subject.shape[0]
+    m_cand = r_tot + 2 * n + n_ext
     total = (jnp.sum(confirm).astype(jnp.int32)
              + ops.gsum(jnp.sum(refute).astype(jnp.int32))
              + ops.gsum(jnp.sum(suspect).astype(jnp.int32)))
@@ -954,7 +1001,16 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     ci2 = jnp.where(ci2 < n, r_tot + ci2, m_cand)
     ci3 = ops.first_true_nodes(suspect, ob)
     ci3 = jnp.where(ci3 < n, r_tot + n + ci3, m_cand)
-    cand = jnp.concatenate([ci1, ci2, ci3])
+    chans = [ci1, ci2, ci3]
+    if ext is not None:
+        # external channel (host bridge): replicated [E] entries, lowest
+        # priority — an external claim never displaces an internal one
+        ext_valid = ext.subject >= 0
+        total = total + jnp.sum(ext_valid).astype(jnp.int32)
+        chans.append(jnp.where(
+            ext_valid,
+            r_tot + 2 * n + jnp.arange(n_ext, dtype=jnp.int32), m_cand))
+    cand = jnp.concatenate(chans)
     mk_, _ = jax.lax.top_k(jnp.where(cand < m_cand, m_cand - cand, 0), ob)
     ci = jnp.where(mk_ > 0, m_cand - mk_, m_cand)
     got = ci < m_cand
@@ -964,23 +1020,45 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     i1 = jnp.clip(ci, 0, r_tot - 1)
     is2 = got & ~is1 & (ci < r_tot + n)
     j2 = jnp.clip(ci - r_tot, 0, n - 1)
-    is3 = got & ~is1 & ~is2
+    is3 = got & ~is1 & ~is2 & (ci < r_tot + 2 * n)
     j3 = jnp.clip(ci - r_tot - n, 0, n - 1)
+    if ext is not None:
+        is4 = got & ~is1 & ~is2 & ~is3
+        j4 = jnp.clip(ci - r_tot - 2 * n, 0, n_ext - 1)
+        sub3 = jnp.where(is3, ops.gather(susp_subject, j3),
+                         ext.subject[j4])
+        key3 = jnp.where(is3, ops.gather(susp_key, j3), ext.key[j4])
+        org3 = jnp.where(is3, ops.gather(susp_orig, j3), ext.origin[j4])
+        hear3 = jnp.where(is3, org3, ext.hearer[j4])
+    else:
+        sub3 = ops.gather(susp_subject, j3)
+        key3 = ops.gather(susp_key, j3)
+        org3 = ops.gather(susp_orig, j3)
+        hear3 = org3
     subj_c = jnp.where(
         got, jnp.where(is1, subject[i1],
-                       jnp.where(is2, j2, ops.gather(susp_subject, j3))),
+                       jnp.where(is2, j2, sub3)),
         -1)
     key_c = jnp.where(
         got, jnp.where(
             is1, dead_key_r[i1],
             jnp.where(is2,
                       lattice.alive_key(ops.gather(new_inc, j2)),
-                      ops.gather(susp_key, j3))), 0)
+                      key3)), 0)
     orig_c = jnp.where(
         got, jnp.where(is1, jnp.maximum(conf_node[i1], 0),
-                       jnp.where(is2, j2, ops.gather(susp_orig, j3))), 0)
+                       jnp.where(is2, j2, org3)), 0)
+    if ext is not None:
+        # who gets the heard-bit: the datagram's receiving node for
+        # external entries, the originator itself everywhere else
+        hear_c = jnp.where(
+            got, jnp.where(is1, jnp.maximum(conf_node[i1], 0),
+                           jnp.where(is2, j2, hear3)), 0)
+        susp_c = is3 | (is4 & lattice.is_suspect(key_c))
+    else:
+        hear_c = orig_c
+        susp_c = is3
     srcslot_c = jnp.where(got & is1, i1, -1)
-    susp_c = is3
     overflow = overflow + jnp.maximum(total - ob, 0)
 
     # dedup within candidates (earlier wins) and vs the live table
@@ -1026,7 +1104,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # bit patterns with the one-hot.
     fw = jnp.clip(lane_c // WORD, 0, g.ow - 1)
     fbit = (jnp.clip(lane_c, 0, ob - 1) % WORD).astype(jnp.uint32)
-    orig_rows = jnp.where(alloc_ok, orig_c, n)
+    orig_rows = jnp.where(alloc_ok, hear_c, n)
     win = ops.scatter_or_word(
         win, orig_rows, g.ww - g.ow + fw,
         jnp.where(alloc_ok, jnp.uint32(1) << fbit, jnp.uint32(0)))

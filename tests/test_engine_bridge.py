@@ -1,0 +1,214 @@
+"""The TPUSimTransport seam: foreign cores against the tensor simulation.
+
+An untouched SWIM core — the in-process Python `Node` (which knows only
+Clock + Transport) and the independent C++ implementation
+(swim_tpu/native/bridge_client.cpp) — joins a cluster whose OTHER
+members exist only as rows of the ring engine's tensor state
+(bridge/engine_server.py), over the unchanged lockstep TCP protocol.
+
+Proof obligations (VERDICT r2 "Missing #3" / "Next 4"):
+  * the core joins and converges on a membership sample,
+  * it detects an injected crash of a tensor-simulated peer,
+  * its refutation of a (wire-forged) suspicion lands in tensor state —
+    provably from the core: the engine's shadow row never sees the
+    suspicion, so inc_self[X] stays 0 in-engine while alive(X, ≥1)
+    appears in the rumor table.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.bridge import EngineBridgeServer, ExternalNodeHost
+from swim_tpu.core import codec
+from swim_tpu.types import Status
+
+# engine geometry for tests: small knobs = fast compile; the protocol
+# semantics (suspicion, dissemination, refutation) are untouched
+GEOM = dict(k_indirect=1, max_piggyback=4, ring_window_periods=3,
+            suspicion_mult=2.0)
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "swim_tpu", "native")
+
+
+def alive_keys(server, member):
+    """ALIVE keys with a bumped incarnation (>= 1) — i.e. refutations.
+    (Key 0 is the vacuous alive(0); gone_key starts there.)"""
+    keys = server.table_keys(member)
+    keys.append(int(np.asarray(server.state.gone_key[member])))
+    return [k for k in keys if k >= 2 and not (k >> 31) and not (k & 1)]
+
+
+def dead_view_of(server, member):
+    keys = server.table_keys(member)
+    keys.append(int(np.asarray(server.state.gone_key[member])))
+    return any(k >> 31 for k in keys)
+
+
+class TestPythonCore:
+    def test_join_detect_and_refute(self):
+        n = 4096
+        x, victim = n - 1, 64            # victim is in the join sample
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_id=x, seed=2)
+        server.start()
+        host = ExternalNodeHost(server.address, quantum=0.25)
+        try:
+            node = host.add_node(SwimConfig(n_nodes=n, **GEOM), x,
+                                 seeds=[7], seed=5)
+            host.run(6.0)
+            assert len(node.members.ids()) >= 16, "join snapshot too small"
+
+            # crash a tensor-simulated peer; the engine detects it and
+            # the dissemination reaches the core through the mirror seam
+            host.kill(victim)
+            host.run(30.0)
+            op = node.members.opinion(victim)
+            assert op is not None and op.status == Status.DEAD, op
+
+            # forge suspect(X) ON THE WIRE ONLY; the core must refute,
+            # and the refutation must land in tensor state
+            assert int(np.asarray(server.state.inc_self[x])) == 0
+            server.deliver_forged(3, [codec.WireUpdate(
+                member=x, status=Status.SUSPECT, incarnation=0,
+                addr=("sim", x), origin=3)])
+            host.run(12.0)
+            assert alive_keys(server, x), (
+                "core refutation did not land in tensor state: "
+                f"{[hex(k) for k in server.table_keys(x)]}")
+            # the engine's shadow row never refuted — the rumor can only
+            # have come through the external-origination seam
+            assert int(np.asarray(server.state.inc_self[x])) == 0
+
+            # the core stayed alive in the engine's eyes throughout
+            assert not server._x_crashed
+            assert not dead_view_of(server, x)
+            # and no false deaths of live engine peers in the core's view
+            false_dead = [m for m in node.members.ids()
+                          if m != victim
+                          and node.members.opinion(m).status == Status.DEAD]
+            assert not false_dead, false_dead
+        finally:
+            host.close()
+            server.join(timeout=30)
+
+
+class TestSilentCore:
+    def test_silent_core_is_organically_detected(self):
+        """A core that joins and then never answers the mirrored probes
+        must be suspected and confirmed dead BY THE ENGINE."""
+        import socket
+
+        from swim_tpu.bridge import protocol as bp
+
+        n = 4096
+        x = 1234
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_id=x, seed=4,
+                                    ack_grace=2)
+        server.start()
+        sock = socket.create_connection(server.address)
+        try:
+            bp.write_frame(sock, bp.Frame(bp.HELLO, a=x))
+            assert bp.read_frame(sock).op == bp.WELCOME
+            for _ in range(30):          # 30 periods, acking nothing
+                bp.write_frame(sock, bp.Frame(bp.STEP, t=1.0))
+                while True:
+                    f = bp.read_frame(sock)
+                    if f.op == bp.TIME:
+                        break
+            assert server._x_crashed, "silent core never crash-gated"
+            assert dead_view_of(server, x), (
+                "engine did not confirm the silent core dead: "
+                f"{[hex(k) for k in server.table_keys(x)]}")
+            bp.write_frame(sock, bp.Frame(bp.BYE))
+        finally:
+            sock.close()
+            server.join(timeout=30)
+
+
+@pytest.fixture(scope="module")
+def client_bin(tmp_path_factory):
+    exe = tmp_path_factory.mktemp("native") / "bridge_client"
+    src = os.path.join(NATIVE_DIR, "bridge_client.cpp")
+    try:
+        subprocess.run(["g++", "-O2", "-std=c++17", "-o", str(exe), src],
+                       check=True, capture_output=True, timeout=180)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"no native toolchain: {e}")
+    return str(exe)
+
+
+def parse_members(stdout: str):
+    members, self_inc = {}, None
+    for line in stdout.splitlines():
+        parts = line.split()
+        if parts and parts[0] == "member":
+            members[int(parts[1])] = (int(parts[2]), int(parts[3]))
+        elif parts and parts[0] == "self":
+            self_inc = int(parts[2])
+    return members, self_inc
+
+
+class TestCppCore64k:
+    def test_cpp_core_joins_64k_engine_cluster(self, client_bin):
+        """The verdict's scenario: the compiled C++ core joins a 65,536-
+        node engine-simulated cluster, detects an injected crash, and
+        its refutation lands in tensor state."""
+        n = 65_536
+        x, victim = n - 1, 320           # victim in the join sample
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_id=x, seed=6)
+        server.start()
+        host, port = server.address
+        # client KILLs the victim itself at t=8 (fault injection over
+        # the wire), runs 60 virtual seconds
+        proc = subprocess.Popen(
+            [client_bin, str(host), str(port), str(x), "7", "60.0",
+             "0.5", str(victim), "8.0"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        try:
+            # once the co-simulation is past period 20, forge suspect(X)
+            # on the wire; the C++ core must refute
+            deadline = time.time() + 600
+            while server.t < 20 and proc.poll() is None:
+                if time.time() > deadline:
+                    pytest.fail("co-simulation stalled before t=20")
+                time.sleep(0.5)
+            server.deliver_forged(3, [codec.WireUpdate(
+                member=x, status=Status.SUSPECT, incarnation=0,
+                addr=("sim", x), origin=3)])
+            out, err = proc.communicate(timeout=600)
+        finally:
+            proc.kill()
+            server.join(timeout=60)
+        assert proc.returncode == 0, err[-2000:]
+        members, self_inc = parse_members(out)
+
+        # joined and discovered a healthy sample of the 64k cluster
+        assert len(members) >= 64, len(members)
+        # detected the killed tensor-simulated peer
+        assert members.get(victim, (None,))[0] == int(Status.DEAD), (
+            members.get(victim))
+        # no false deaths among the other tensor peers it tracked
+        false_dead = [m for m, (st, _) in members.items()
+                      if m != victim and st == int(Status.DEAD)]
+        assert not false_dead, false_dead
+        # the core refuted the forged suspicion...
+        assert self_inc is not None and self_inc >= 1, self_inc
+        # ...and the refutation LANDED IN TENSOR STATE, provably from
+        # the core (the engine's shadow row never saw a suspicion)
+        assert int(np.asarray(server.state.inc_self[x])) == 0
+        assert alive_keys(server, x), (
+            f"refutation missing: {[hex(k) for k in server.table_keys(x)]}")
+        # the core stayed alive in the engine's eyes (acked every
+        # mirrored probe); no dead view of it anywhere in tensor state
+        assert not server._x_crashed
+        assert not dead_view_of(server, x)

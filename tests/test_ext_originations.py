@@ -1,0 +1,139 @@
+"""The Phase-D external-origination channel (host-bridge seam).
+
+ring.step(ext=...) must (a) change NOTHING when the batch is empty,
+(b) allocate injected rumors into the table with the datagram receiver
+holding the heard-bit, (c) dedup against existing rumors, and
+(d) spread injected claims to the whole cluster via the normal waves.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import ring
+from swim_tpu.ops import lattice
+from swim_tpu.sim import faults
+
+N = 64
+
+
+def mk(n=N, **kw):
+    cfg = SwimConfig(n_nodes=n, **kw)
+    return cfg, ring.init_state(cfg), faults.none(n)
+
+
+def run_periods(cfg, state, plan, periods, ext_by_period=None, seed=0):
+    key = jax.random.key(seed)
+    step = jax.jit(functools.partial(ring.step, cfg))
+    step_ext = jax.jit(functools.partial(ring.step, cfg))
+    for t in range(periods):
+        rnd = ring.draw_period_ring(key, t, cfg)
+        ext = (ext_by_period or {}).get(t)
+        if ext is None:
+            state = step(state, plan, rnd)
+        else:
+            state = step_ext(state, plan, rnd, ext=ext)
+    return state
+
+
+def inject(entries, capacity=8):
+    e = ring.ext_none(capacity)
+    for i, (subj, key, origin, hearer) in enumerate(entries):
+        e = e._replace(
+            subject=e.subject.at[i].set(subj),
+            key=e.key.at[i].set(jnp.uint32(key)),
+            origin=e.origin.at[i].set(origin),
+            hearer=e.hearer.at[i].set(hearer))
+    return e
+
+
+def table_lookup(state, subj):
+    su = np.asarray(state.subject)
+    rk = np.asarray(state.rkey)
+    return rk[su == subj]
+
+
+def test_empty_batch_is_bitwise_noop():
+    cfg, state, plan = mk()
+    a = run_periods(cfg, state, plan, 6)
+    b = run_periods(cfg, state, plan, 6,
+                    ext_by_period={t: ring.ext_none(8) for t in range(6)})
+    for name in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def test_injected_rumor_lands_and_hearer_gets_bit():
+    cfg, state, plan = mk()
+    akey = int(lattice.alive_key(jnp.uint32(7)))
+    ext = inject([(5, akey, 5, 12)])
+    out = run_periods(cfg, state, plan, 1, ext_by_period={0: ext})
+    keys = table_lookup(out, 5)
+    assert akey in keys.tolist()
+    # the hearer (node 12) holds the heard-bit for the new slot
+    su = np.asarray(out.subject)
+    rk = np.asarray(out.rkey)
+    (slot,) = [i for i in range(len(su))
+               if su[i] == 5 and rk[i] == akey]
+    words = np.asarray(ring.resolved_words(cfg, out))
+    assert (words[12, slot // 32] >> (slot % 32)) & 1 == 1
+    # and nobody else does yet (one period, no waves carried it: the
+    # injection lands in the fresh word, transmissible from next period)
+    col = words[:, slot // 32] >> (slot % 32) & 1
+    assert int(col.sum()) == 1
+
+
+def test_duplicate_and_existing_injections_dedup():
+    cfg, state, plan = mk()
+    akey = int(lattice.alive_key(jnp.uint32(3)))
+    ext = inject([(9, akey, 9, 4), (9, akey, 9, 30)])
+    out = run_periods(cfg, state, plan, 1, ext_by_period={0: ext})
+    assert len(table_lookup(out, 9)) == 1
+    # re-injecting the same rumor next period must not allocate again
+    ext2 = inject([(9, akey, 9, 11)])
+    rnd = ring.draw_period_ring(jax.random.key(0), 1, cfg)
+    out2 = ring.step(cfg, out, plan, rnd, ext=ext2)
+    assert len(table_lookup(out2, 9)) == 1
+
+
+def test_injected_suspicion_spreads_and_is_refuted():
+    """An external suspicion of a LIVE engine node must disseminate and
+    then be organically refuted by the engine (incarnation bump)."""
+    cfg, state, plan = mk()
+    skey = int(lattice.suspect_key(jnp.uint32(0)))
+    ext = inject([(20, skey, 63, 40)])   # claim by 63, heard by 40
+    out = run_periods(cfg, state, plan, 18, ext_by_period={0: ext})
+    # node 20 refuted: its self-incarnation advanced past the suspicion
+    assert int(np.asarray(out.inc_self)[20]) >= 1
+    # and the refutation outranks the suspicion in tensor state — either
+    # still a live table rumor, or already fully disseminated into the
+    # gone_key floor (rumors retire after their spread budget)
+    alive_new = int(lattice.alive_key(jnp.uint32(1)))
+    keys = [int(k) for k in table_lookup(out, 20)]
+    keys.append(int(np.asarray(out.gone_key)[20]))
+    assert any(k >= alive_new and not (k & 1) and not (k >> 31)
+               for k in keys), [hex(k) for k in keys]
+
+
+def test_injected_death_disseminates_to_all_views():
+    cfg, state, plan = mk()
+    dkey = int(lattice.dead_key(jnp.uint32(0)))
+    ext = inject([(33, dkey, 7, 7)])
+    out = run_periods(cfg, state, plan, 20, ext_by_period={2: ext})
+    gone = int(np.asarray(out.gone_key)[33])
+    if (gone >> 31) & 1:
+        return  # fully disseminated + tombstoned: every view is DEAD
+    su = np.asarray(out.subject)
+    rk = np.asarray(out.rkey)
+    slots = [i for i in range(len(su))
+             if su[i] == 33 and (int(rk[i]) >> 31)]
+    assert slots, "dead rumor vanished without a tombstone"
+    words = np.asarray(ring.resolved_words(cfg, out))
+    sl = slots[0]
+    frac = float(((words[:, sl // 32] >> (sl % 32)) & 1).mean())
+    assert frac > 0.9, f"dead(33) reached only {frac:.0%} of nodes"

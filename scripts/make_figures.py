@@ -126,7 +126,74 @@ def fig_fp_suppression():
         print(f"  loss {l:.2f}: vanilla {v}, lifeguard {g}")
 
 
+def fig_suspicion_tradeoff():
+    """λ-sweep trade-off (BASELINE config 4) from the committed 1M-node
+    sweep artifact: false-DEAD views vs dead-declaration latency, one
+    curve per loss rate, λ annotated per point. Reads the newest
+    mults×losses grid JSON in bench_results/ (CPU fallback or TPU
+    capture); silently skips if none exists yet."""
+    import glob
+    import json
+
+    cands = sorted(glob.glob(os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "bench_results",
+        "study_suspicion_1m*.json")), key=os.path.getmtime)
+    grid = None
+    for path in reversed(cands):
+        with open(path) as f:
+            doc = json.load(f)
+        doc = doc.get("result", doc) or {}
+        pts = doc.get("points", [])
+        if len({p.get("loss") for p in pts}) >= 2:
+            grid, src = doc, path
+            break
+    if grid is None:
+        print("no mults x losses grid artifact yet; skipping tradeoff fig")
+        return
+    fig, ax = plt.subplots(figsize=(6.4, 4.0), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    style_axes(ax)
+    palette = (S1, S2, "#3d9970", "#8e6bc1", "#b0672f")
+    for i, loss in enumerate(sorted({p["loss"] for p in grid["points"]})):
+        color = palette[i % len(palette)]
+        pts = [p for p in grid["points"] if p["loss"] == loss]
+        pts.sort(key=lambda p: p["suspicion_mult"])
+        # a point with no dead_view_latency_mean means NO dead view was
+        # ever declared (detection_summary omits the key then) — that is
+        # the WORST latency, not 0; plot only measured points and name
+        # the suppressed ones in the legend entry
+        meas = [p for p in pts if "dead_view_latency_mean" in p]
+        never = [p["suspicion_mult"] for p in pts
+                 if "dead_view_latency_mean" not in p]
+        x = [p["dead_view_latency_mean"] for p in meas]
+        y = [p["false_dead_views_peak"] for p in meas]
+        label = f"loss {100 * loss:.0f}%"
+        if never:
+            label += f" (λ={','.join(f'{m:g}' for m in never)}: never)"
+        ax.plot(x, y, color=color, linewidth=1.8, marker="o",
+                markersize=4.5, label=label)
+        for p, xi, yi in zip(meas, x, y):
+            ax.annotate(f"λ={p['suspicion_mult']:g}", (xi, yi),
+                        textcoords="offset points", xytext=(5, 4),
+                        fontsize=7.5, color=INK2)
+    ax.set_yscale("symlog", linthresh=10)
+    ax.set_xlabel("mean dead-declaration latency (periods)", color=INK)
+    ax.set_ylabel(f"false-DEAD views, peak (N={grid['n']:,})", color=INK)
+    ax.set_title("Suspicion multiplier λ buys FP suppression with "
+                 "detection latency", color=INK, fontsize=11, loc="left")
+    ax.legend(frameon=False, fontsize=8.5, labelcolor=INK2,
+              loc="upper right")
+    fig.tight_layout()
+    path = os.path.join(OUT, "suspicion_tradeoff.png")
+    fig.savefig(path, facecolor=SURFACE)
+    print(f"wrote {path} (from {os.path.basename(src)})")
+
+
 if __name__ == "__main__":
     os.makedirs(OUT, exist_ok=True)
-    fig_detection_cdf()
-    fig_fp_suppression()
+    if "--tradeoff-only" in sys.argv:
+        fig_suspicion_tradeoff()
+    else:
+        fig_detection_cdf()
+        fig_fp_suppression()
+        fig_suspicion_tradeoff()

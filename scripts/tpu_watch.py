@@ -88,9 +88,11 @@ CAPTURES: list[tuple[str, list[str], float, bool]] = [
       "1000000", "--engine", "ring", "--periods", "100",
       "--mults", "1.0", "2.0", "3.0", "4.0", "6.0",
       "--losses", "0.02", "0.05"], 10800, True),
+    # 4 arms (vanilla/lifeguard × OB 64/256): budget-vs-LHA attribution
     ("study_lifeguard_1m",
      ["-m", "swim_tpu.cli", "study", "lifeguard", "--nodes", "1000000",
-      "--engine", "ring", "--periods", "100"], 3600, True),
+      "--engine", "ring", "--periods", "100", "--budget-arms"], 7200,
+     True),
 ]
 
 

@@ -286,6 +286,8 @@ class EngineBridgeServer:
         elif msg.kind == MsgKind.ACK:
             self._last_ack = self.t      # the core answered a mirrored ping
         elif msg.kind == MsgKind.JOIN:
+            if self._lost():             # reply leg draws loss too (D4)
+                return
             self._deliver(dst, codec.Message(
                 kind=MsgKind.JOIN_REPLY, sender=dst,
                 gossip=self._join_snapshot()))

@@ -200,6 +200,7 @@ def _cmd_study(args: argparse.Namespace) -> int:
     elif args.study == "lifeguard":
         kw["crash_fraction"] = args.crash_fraction
         kw["loss"] = args.loss
+        kw["budget_arms"] = args.budget_arms
     print(json.dumps(experiments.STUDIES[args.study](**kw)))
     return 0
 
@@ -283,6 +284,9 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--mults", type=float, nargs="*",
                     default=[2.0, 3.0, 5.0, 8.0])
     st.add_argument("--no-partition", action="store_true")
+    st.add_argument("--budget-arms", action="store_true",
+                    help="lifeguard study: add ring_orig_words=8 twin "
+                         "arms (budget-vs-LHA attribution)")
     st.set_defaults(fn=_cmd_study)
 
     br = sub.add_parser(

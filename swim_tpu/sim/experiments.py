@@ -40,6 +40,32 @@ def pick_engine(n: int, engine: str = "auto") -> str:
     return "dense" if n <= DENSE_MAX else "rumor"
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_ring_study(cfg: SwimConfig, engine: str, periods: int, mesh):
+    """One jitted ring-study runner per (cfg, engine, periods).
+
+    Without this, every study point re-traces the scan with the fault
+    plan baked in as constants — a sweep over loss rates (same cfg,
+    different plan) recompiles the identical program per point, which
+    at 1M nodes is minutes of XLA per recompile. Plan and key are
+    traced arguments here, so loss-only grid points share one compile.
+    """
+    from swim_tpu.models import ring
+    from swim_tpu.parallel import ring_shard
+
+    step_fn = (ring_shard.mapped_step(cfg, mesh)
+               if engine == "ringshard" else None)
+
+    def go(state, plan, key):
+        return runner.run_study_ring(cfg, state, plan, key, periods,
+                                     step_fn)
+
+    return jax.jit(go)
+
+
 def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
                periods: int, engine: str):
     mesh = pmesh.make_mesh()
@@ -58,8 +84,8 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
 
         state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
                                        plan)
-        return runner.run_study_ring(cfg, state, plan, key, periods,
-                                     ring_shard.mapped_step(cfg, mesh))
+        return _compiled_ring_study(cfg, "ringshard", periods, mesh)(
+            state, plan, key)
     plan = pmesh.shard_state(plan, mesh, n=n)
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
@@ -68,7 +94,8 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
         from swim_tpu.models import ring
 
         state = pmesh.shard_state(ring.init_state(cfg), mesh, n=n)
-        return runner.run_study_ring(cfg, state, plan, key, periods)
+        return _compiled_ring_study(cfg, "ring", periods, mesh)(
+            state, plan, key)
     state = pmesh.shard_state(rumor.init_state(cfg), mesh, n=n)
     return runner.run_study_rumor(cfg, state, plan, key, periods)
 
@@ -171,12 +198,31 @@ def suspicion_sweep(n: int = 1_000_000,
 
 def lifeguard_ablation(n: int = 1_000_000, crash_fraction: float = 0.001,
                        loss: float = 0.2, periods: int = 100, seed: int = 0,
-                       engine: str = "auto", **cfg_kw) -> dict[str, Any]:
-    """Config 5: Lifeguard extensions vs vanilla SWIM under lossy churn."""
+                       engine: str = "auto", budget_arms: bool = False,
+                       **cfg_kw) -> dict[str, Any]:
+    """Config 5: Lifeguard extensions vs vanilla SWIM under lossy churn.
+
+    `budget_arms=True` adds big-origination-budget twins of both arms
+    (ring engines only: ring_orig_words 2→8, i.e. OB 64→256).  This
+    separates the two candidate causes of the 1M-scale Lifeguard
+    detection-latency regression (docs/RESULTS.md §5: suspect latency
+    24.1 vs vanilla's 2.4 periods): LHA probe-thinning (intrinsic to
+    Lifeguard) vs origination-budget throttling (an engine capacity
+    knob).  If `lifeguard_ob8` recovers vanilla-like latency while
+    keeping ~0 false-dead views, the regression is buyable-off with
+    budget alone.
+    """
     engine = pick_engine(n, engine)
+    arm_defs = [("vanilla", False, {}), ("lifeguard", True, {})]
+    if budget_arms:
+        if engine not in ("ring", "ringshard"):
+            raise ValueError("budget_arms sweeps ring_orig_words — ring "
+                             "engines only")
+        arm_defs += [("vanilla_ob8", False, {"ring_orig_words": 8}),
+                     ("lifeguard_ob8", True, {"ring_orig_words": 8})]
     arms = {}
-    for name, lg in (("vanilla", False), ("lifeguard", True)):
-        cfg = SwimConfig(n_nodes=n, lifeguard=lg, **cfg_kw)
+    for name, lg, extra in arm_defs:
+        cfg = SwimConfig(n_nodes=n, lifeguard=lg, **{**cfg_kw, **extra})
         plan = faults.with_loss(
             faults.with_random_crashes(
                 faults.none(n), jax.random.key(seed + 1), crash_fraction,
@@ -186,6 +232,7 @@ def lifeguard_ablation(n: int = 1_000_000, crash_fraction: float = 0.001,
         arm = runner.detection_summary(res, plan, periods)
         arm["false_dead_views_peak"] = int(np.asarray(
             res.series.false_dead_views).max())
+        arm["ring_orig_words"] = cfg.ring_orig_words
         arms[name] = arm
     return {"study": "lifeguard_ablation", "n": n, "periods": periods,
             "engine": engine, "loss": loss, "arms": arms}

@@ -52,6 +52,82 @@ def dead_view_of(server, member):
     return any(k >> 31 for k in keys)
 
 
+class TestHostMirrors:
+    def test_resolved_row_matches_canonical_layout(self):
+        """engine_server re-derives the win/cold ring-word layout
+        host-side (per-node extraction must not pull the full [N, RW]
+        resolved matrix). Pin it against ring.resolved_words — the
+        function ring.py declares canonical — over several periods so a
+        layout change cannot silently desynchronize the seam."""
+        import functools
+
+        import jax
+
+        from swim_tpu.models import ring
+        from swim_tpu.sim import faults
+
+        n = 128
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer.__new__(EngineBridgeServer)
+        server.cfg = cfg
+        server._ring = ring
+        plan = faults.with_crashes(faults.none(n), [5], [1])
+        state = ring.init_state(cfg)
+        step = jax.jit(functools.partial(ring.step, cfg))
+        key = jax.random.key(3)
+        for t in range(6):
+            state = step(state, plan, ring.draw_period_ring(key, t, cfg))
+            server.state = state
+            canon = np.asarray(ring.resolved_words(cfg, state))
+            for x in (0, 5, n - 1):
+                mine = server._resolved_row(x)
+                bits = np.unpackbits(
+                    canon[x].astype("<u4").view(np.uint8),
+                    bitorder="little").astype(bool)
+                np.testing.assert_array_equal(mine, bits, err_msg=f"t={t}")
+
+    def test_transmissible_slots_are_window_resident(self):
+        """_transmissible's word→slot mapping must agree with the slot
+        arithmetic: every update it returns corresponds to a used table
+        slot whose bit the node actually holds in the resolved row."""
+        import functools
+
+        import jax
+
+        from swim_tpu.models import ring
+        from swim_tpu.sim import faults
+
+        n = 128
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer.__new__(EngineBridgeServer)
+        server.cfg = cfg
+        server._ring = ring
+        plan = faults.with_crashes(faults.none(n), [5], [1])
+        state = ring.init_state(cfg)
+        step = jax.jit(functools.partial(ring.step, cfg))
+        key = jax.random.key(3)
+        # 8 periods: the suspect(5) rumor reaches ~all 127 live nodes
+        # (measured knower growth: 1,3,9,26,64,121,127)
+        for t in range(8):
+            state = step(state, plan, ring.draw_period_ring(key, t, cfg))
+        server.state = state
+        server._subject = np.asarray(state.subject)
+        server._rkey = np.asarray(state.rkey)
+        su = server._subject
+        nonempty = 0
+        for node in range(n):
+            ups = server._transmissible(node)
+            row = server._resolved_row(node)
+            for u in ups:
+                slots = [i for i in range(len(su))
+                         if su[i] == u.member and row[i]]
+                assert slots, (f"node {node}: update {u} not backed by "
+                               f"a held table slot")
+            nonempty += bool(ups)
+        assert nonempty >= 100, (
+            f"only {nonempty}/128 nodes gossip after 8 churn periods")
+
+
 class TestPythonCore:
     def test_join_detect_and_refute(self):
         n = 4096
@@ -163,7 +239,9 @@ class TestCppCore64k:
         node engine-simulated cluster, detects an injected crash, and
         its refutation lands in tensor state."""
         n = 65_536
-        x, victim = n - 1, 320           # victim in the join sample
+        # join-snapshot stride is n // join_sample = 512, so a 512-
+        # multiple victim is genuinely in the core's bootstrap sample
+        x, victim = n - 1, 512
         cfg = SwimConfig(n_nodes=n, **GEOM)
         server = EngineBridgeServer(cfg, external_id=x, seed=6)
         server.start()

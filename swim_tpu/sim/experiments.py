@@ -20,6 +20,7 @@ scalars and O(N) milestone vectors reach the host.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable
 
 import jax
@@ -40,30 +41,21 @@ def pick_engine(n: int, engine: str = "auto") -> str:
     return "dense" if n <= DENSE_MAX else "rumor"
 
 
-import functools
-
-
 @functools.lru_cache(maxsize=16)
-def _compiled_ring_study(cfg: SwimConfig, engine: str, periods: int, mesh):
-    """One jitted ring-study runner per (cfg, engine, periods).
+def _mapped_step(cfg: SwimConfig, mesh):
+    """Identity-stable sharded step per (cfg, mesh).
 
-    Without this, every study point re-traces the scan with the fault
-    plan baked in as constants — a sweep over loss rates (same cfg,
-    different plan) recompiles the identical program per point, which
-    at 1M nodes is minutes of XLA per recompile. Plan and key are
-    traced arguments here, so loss-only grid points share one compile.
+    `run_study_ring` is jitted with `step_fn` as a STATIC argument, so
+    its compile cache is keyed on the function object's identity — a
+    fresh `ring_shard.mapped_step` closure per study point forced a
+    full recompile per point (at 1M nodes, minutes of XLA each) even
+    when cfg was unchanged. Memoizing the closure lets loss-only grid
+    points (same cfg, different fault plan — plan is a traced arg)
+    share one compile.
     """
-    from swim_tpu.models import ring
     from swim_tpu.parallel import ring_shard
 
-    step_fn = (ring_shard.mapped_step(cfg, mesh)
-               if engine == "ringshard" else None)
-
-    def go(state, plan, key):
-        return runner.run_study_ring(cfg, state, plan, key, periods,
-                                     step_fn)
-
-    return jax.jit(go)
+    return ring_shard.mapped_step(cfg, mesh)
 
 
 def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
@@ -84,8 +76,8 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
 
         state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
                                        plan)
-        return _compiled_ring_study(cfg, "ringshard", periods, mesh)(
-            state, plan, key)
+        return runner.run_study_ring(cfg, state, plan, key, periods,
+                                     _mapped_step(cfg, mesh))
     plan = pmesh.shard_state(plan, mesh, n=n)
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
@@ -94,8 +86,7 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
         from swim_tpu.models import ring
 
         state = pmesh.shard_state(ring.init_state(cfg), mesh, n=n)
-        return _compiled_ring_study(cfg, "ring", periods, mesh)(
-            state, plan, key)
+        return runner.run_study_ring(cfg, state, plan, key, periods)
     state = pmesh.shard_state(rumor.init_state(cfg), mesh, n=n)
     return runner.run_study_rumor(cfg, state, plan, key, periods)
 

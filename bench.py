@@ -40,6 +40,7 @@ the headline engine; "both" = dense + ring, "all" = every engine).
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import subprocess
@@ -89,14 +90,50 @@ def force_cpu_platform(n_devices: int = CPU_FALLBACK_DEVICES) -> None:
 # --------------------------------------------------------------------------
 
 def _time_run(run, state, warmup: int, periods: int) -> float:
-    import jax
+    """Time run(state, seed) for one seed after `warmup` distinct seeds.
 
-    for _ in range(warmup):
-        jax.block_until_ready(run(state))
+    Every call uses a DIFFERENT seed (folded into the engine's root key)
+    so no two dispatches are identical: the axon TPU tunnel was observed
+    to serve a repeated (executable, args) pair from cache in ~150 us,
+    which fabricated a 316k periods/sec "measurement" (BENCH_r02 era).
+    Distinct seeds force a real execution per call; the workload is
+    statistically identical.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def sync(out):
+        """Force completion: fetch a scalar from every output leaf group.
+
+        `jax.block_until_ready` alone is NOT sufficient on the axon
+        tunnel — for shard_map executables it returns at enqueue time
+        (observed: a 50-period 1M-node scan "completing" in 158 us).  A
+        host fetch of an output value cannot complete before the program
+        has, so the fetch is the barrier.
+        """
+        jax.block_until_ready(out)
+        step = getattr(out, "step", None)
+        if step is not None:
+            return int(step)
+        leaf = jax.tree.leaves(out)[0]
+        return int(np.asarray(leaf).ravel()[0])
+
+    for i in range(warmup):
+        sync(run(state, jnp.int32(i)))
     t0 = time.perf_counter()
-    out = run(state)
-    jax.block_until_ready(out)
-    return periods / (time.perf_counter() - t0)
+    out = run(state, jnp.int32(warmup))
+    end_step = sync(out)
+    elapsed = time.perf_counter() - t0
+    # Execution proof: the timed run starts from the same initial state,
+    # so the output's step counter MUST have advanced exactly `periods`.
+    if getattr(out, "step", None) is not None:
+        done = end_step - int(getattr(state, "step", 0) or 0)
+        if done != periods:
+            raise RuntimeError(
+                f"timed run did not execute: step advanced {done}, "
+                f"expected {periods}")
+    return periods / elapsed
 
 
 def bench_dense(n_nodes: int, periods: int, warmup: int = 2) -> float:
@@ -115,7 +152,8 @@ def bench_dense(n_nodes: int, periods: int, warmup: int = 2) -> float:
     plan = pmesh.shard_state(plan, mesh, n=n_nodes)
     key = jax.random.key(0)
     run = jax.jit(
-        lambda st: dense.run(cfg, st, plan, key, periods),
+        lambda st, seed: dense.run(cfg, st, plan,
+                                   jax.random.fold_in(key, seed), periods),
         out_shardings=pmesh.state_shardings(state, mesh, n=n_nodes),
     )
     return _time_run(run, state, warmup, periods)
@@ -141,16 +179,20 @@ def bench_rumor(n_nodes: int, periods: int, warmup: int = 2,
     plan = pmesh.shard_state(plan, mesh, n=n_nodes)
     key = jax.random.key(0)
     run = jax.jit(
-        lambda st: rumor.run(cfg, st, plan, key, periods),
+        lambda st, seed: rumor.run(cfg, st, plan,
+                                   jax.random.fold_in(key, seed), periods),
         out_shardings=pmesh.state_shardings(state, mesh, n=n_nodes),
     )
     return _time_run(run, state, warmup, periods)
 
 
 def bench_ring(n_nodes: int, periods: int, warmup: int = 2,
-               crash_fraction: float = 0.001) -> float:
+               crash_fraction: float = 0.001,
+               ring_sel_scope: str = "wave") -> float:
     """Flagship tier: the scatter-free ring engine (models/ring.py) under
-    the same detection workload — crash churn at simulator scale."""
+    the same detection workload — crash churn at simulator scale.  The
+    'ringp' tier is this same harness with ring_sel_scope='period'
+    (deviation R5: one piggyback selection per period, not per wave)."""
     import jax
 
     from swim_tpu import SwimConfig
@@ -158,7 +200,7 @@ def bench_ring(n_nodes: int, periods: int, warmup: int = 2,
     from swim_tpu.parallel import mesh as pmesh
     from swim_tpu.sim import faults
 
-    cfg = SwimConfig(n_nodes=n_nodes)
+    cfg = SwimConfig(n_nodes=n_nodes, ring_sel_scope=ring_sel_scope)
     mesh = pmesh.make_mesh()
     state = pmesh.shard_state(ring.init_state(cfg), mesh, n=n_nodes)
     plan = faults.with_random_crashes(
@@ -167,7 +209,8 @@ def bench_ring(n_nodes: int, periods: int, warmup: int = 2,
     plan = pmesh.shard_state(plan, mesh, n=n_nodes)
     key = jax.random.key(0)
     run = jax.jit(
-        lambda st: ring.run(cfg, st, plan, key, periods),
+        lambda st, seed: ring.run(cfg, st, plan,
+                                  jax.random.fold_in(key, seed), periods),
         out_shardings=pmesh.state_shardings(state, mesh, n=n_nodes),
     )
     return _time_run(run, state, warmup, periods)
@@ -193,8 +236,8 @@ def bench_shard(n_nodes: int, periods: int, warmup: int = 1,
     run = shard_engine.build_run(cfg, mesh, periods)
     key = jax.random.key(0)
 
-    def go(st):
-        return run(st, plan, key)
+    def go(st, seed):
+        return run(st, plan, jax.random.fold_in(key, seed))
 
     return _time_run(go, state, warmup, periods)
 
@@ -220,14 +263,16 @@ def bench_ring_shard(n_nodes: int, periods: int, warmup: int = 2,
     run = ring_shard.build_run(cfg, mesh, periods)
     key = jax.random.key(0)
 
-    def go(st):
-        return run(st, plan, key)
+    def go(st, seed):
+        return run(st, plan, jax.random.fold_in(key, seed))
 
     return _time_run(go, state, warmup, periods)
 
 
 TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
+            "ringp": functools.partial(bench_ring,
+                                       ring_sel_scope="period"),
             "ringshard": bench_ring_shard}
 
 
@@ -246,7 +291,7 @@ def run_tier_child(args) -> int:
         out = {"ok": True, "tier": args._tier,
                "nodes": args.nodes, "periods": args.periods,
                "periods_per_sec": round(pps, 2)}
-        if args._tier in ("ring", "ringshard"):
+        if args._tier in ("ring", "ringp", "ringshard"):
             # Self-describing headline (VERDICT r2 task 7): report probe
             # mode and the HBM roofline band so a green number can never
             # hide a rotor-vs-pull or CPU-vs-TPU apples-to-oranges read.
@@ -255,9 +300,23 @@ def run_tier_child(args) -> int:
             from swim_tpu import SwimConfig
             from swim_tpu.utils import roofline as rl
 
-            cfg = SwimConfig(n_nodes=args.nodes)
+            cfg = SwimConfig(
+                n_nodes=args.nodes,
+                ring_sel_scope=("period" if args._tier == "ringp"
+                                else "wave"))
+            out["ring_sel_scope"] = cfg.ring_sel_scope
             ceil = rl.ceiling_periods_per_sec(cfg)
             out["devices"] = len(jax.devices())
+            # Physical-plausibility guard: the step is HBM-bound, so a
+            # measurement far above the fused-traffic ceiling x devices
+            # cannot be a real execution (observed once: axon backend
+            # returning a no-op) — fail the tier rather than publish it.
+            limit = 3.0 * ceil["ceiling_fused"] * max(out["devices"], 1)
+            if pps > limit:
+                out.update(ok=False, error=(
+                    f"measured {pps:.0f} periods/sec exceeds 3x the "
+                    f"HBM roofline ceiling ({limit:.0f}) — timing "
+                    "artifact, not a real execution"))
             out["ring_probe"] = cfg.ring_probe
             out["v5e_chip_ceiling_pps"] = [
                 round(ceil["ceiling_unfused"], 1),
@@ -313,7 +372,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--tier", default="flagship",
-                    choices=("dense", "rumor", "shard", "ring",
+                    choices=("dense", "rumor", "shard", "ring", "ringp",
                              "ringshard", "flagship", "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
@@ -358,13 +417,16 @@ def main() -> int:
         n_d = min(args.nodes or 1024, 2048)
         periods = args.periods or 20
 
-    # flagship (the default) runs both ring execution layouts — on one
-    # real chip they coincide, but on the multi-core CPU fallback the
-    # explicitly-sharded engine uses the 8 virtual devices and wins
-    tiers = {"flagship": ["ring", "ringshard"],
+    # flagship (the default) runs the exact ring engine (wave-scope
+    # selection), its R5 period-scope variant (ringp — a documented
+    # semantics deviation, labeled in the headline), and the
+    # explicitly-sharded layout (ringshard — coincides with ring on one
+    # chip; on the multi-core CPU fallback it uses the 8 virtual
+    # devices)
+    tiers = {"flagship": ["ring", "ringp", "ringshard"],
              "both": ["dense", "ring"],
-             "all": ["dense", "rumor", "shard", "ring", "ringshard"]}.get(
-        args.tier, [args.tier])
+             "all": ["dense", "rumor", "shard", "ring", "ringp",
+                     "ringshard"]}.get(args.tier, [args.tier])
     results = {}
     for tier in tiers:
         nodes = n_d if tier == "dense" else n_r
@@ -378,7 +440,7 @@ def main() -> int:
     # scalable tier succeeded — its small-N exact-engine pps is not
     # comparable to the 1M-node target.
     head_tier, head = None, None
-    for tier in ("ring", "ringshard", "shard", "rumor"):
+    for tier in ("ring", "ringp", "ringshard", "shard", "rumor"):
         r = results.get(tier)
         if r and r.get("ok"):
             if head is None or r["periods_per_sec"] > head["periods_per_sec"]:
@@ -389,8 +451,10 @@ def main() -> int:
         value = head["periods_per_sec"]
         probe_txt = (f"{head['ring_probe']} probe, "
                      if head.get("ring_probe") else "")
+        scope_txt = ("period-sel, "
+                     if head.get("ring_sel_scope") == "period" else "")
         metric = (f"simulated protocol-periods/sec @ {head['nodes']} nodes "
-                  f"({head_tier} engine, {probe_txt}{platform})")
+                  f"({head_tier} engine, {probe_txt}{scope_txt}{platform})")
     else:
         value = 0.0
         metric = f"simulated protocol-periods/sec (all tiers failed, {platform})"
@@ -405,6 +469,7 @@ def main() -> int:
     }
     if head is not None and head.get("v5e_chip_ceiling_pps"):
         out["ring_probe"] = head["ring_probe"]
+        out["ring_sel_scope"] = head.get("ring_sel_scope", "wave")
         out["v5e_chip_ceiling_pps"] = head["v5e_chip_ceiling_pps"]
         out["bytes_per_period"] = head["bytes_per_period"]
         if on_tpu:

@@ -1,0 +1,133 @@
+"""Window-layout microbench: node-major [N, WW] vs word-major [WW, N].
+
+The ring engine keeps `win` node-major while `cold` is word-major (the
+round-2 transpose that made cold's flush/census passes contiguous).  A
+TPU tiles the MINOR dimension into 128 lanes; WW=12 < 128 means every
+node-major win pass wastes ~90% of each lane tile.  This script times
+the engine's three hot window patterns in both layouts at the 1M-node
+default geometry, so the layout decision is made from measured numbers.
+
+Patterns (per models/ring.py):
+  select  — `_select_first_b`-shaped: WW x B lowest-set-bit loop
+  wave    — roll along the node axis + OR-update into win (one wave)
+  colsel  — per-row window-column select (`_col_select_multi`, one query)
+
+Usage: python scripts/microbench_layout.py [N] [reps]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+WW, B = 12, 6
+
+
+def timeit(name, fn, *args):
+    fn_j = jax.jit(fn)
+    jax.block_until_ready(fn_j(*args))
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = jax.block_until_ready(fn_j(*args))
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:48s} {dt * 1e3:8.3f} ms", flush=True)
+    return out
+
+
+def select_nm(win, elig):                    # node-major [N, WW]
+    budget = jnp.full((N,), B, jnp.int32)
+    taken = [None] * WW
+    for w in range(WW - 1, -1, -1):
+        m = win[:, w] & elig[w]
+        acc = jnp.zeros_like(m)
+        for _ in range(B):
+            low = m & (jnp.uint32(0) - m)
+            bitm = jnp.where(budget > 0, low, jnp.uint32(0))
+            acc = acc | bitm
+            m = m ^ bitm
+            budget = budget - (bitm != 0).astype(jnp.int32)
+        taken[w] = acc
+    return jnp.stack(taken, axis=-1)
+
+
+def select_wm(win, elig):                    # word-major [WW, N]
+    budget = jnp.full((N,), B, jnp.int32)
+    taken = [None] * WW
+    for w in range(WW - 1, -1, -1):
+        m = win[w] & elig[w]
+        acc = jnp.zeros_like(m)
+        for _ in range(B):
+            low = m & (jnp.uint32(0) - m)
+            bitm = jnp.where(budget > 0, low, jnp.uint32(0))
+            acc = acc | bitm
+            m = m ^ bitm
+            budget = budget - (bitm != 0).astype(jnp.int32)
+        taken[w] = acc
+    return jnp.stack(taken, axis=0)
+
+
+def wave_nm(win, sel, ok, s):
+    return win | jnp.where(ok[:, None], jnp.roll(sel, s, axis=0),
+                           jnp.uint32(0))
+
+
+def wave_wm(win, sel, ok, s):
+    return win | jnp.where(ok[None, :], jnp.roll(sel, s, axis=1),
+                           jnp.uint32(0))
+
+
+def colsel_nm(win, wcol):
+    out = jnp.zeros((N,), jnp.uint32)
+    for w in range(WW):
+        out = jnp.where(wcol == w, win[:, w], out)
+    return out
+
+
+def colsel_wm(win, wcol):
+    out = jnp.zeros((N,), jnp.uint32)
+    for w in range(WW):
+        out = jnp.where(wcol == w, win[w], out)
+    return out
+
+
+def main():
+    key = jax.random.key(0)
+    print(f"N={N}, WW={WW}, B={B}, reps={REPS}, "
+          f"platform={jax.devices()[0].platform}")
+    win_nm = jax.random.bits(key, (N, WW), jnp.uint32)
+    win_wm = jnp.asarray(win_nm.T)
+    elig = jax.random.bits(key, (WW,), jnp.uint32)
+    ok = jax.random.bernoulli(key, 0.7, (N,))
+    wcol = jax.random.randint(key, (N,), 0, WW).astype(jnp.int32)
+    s = 12345
+
+    sel_nm = timeit("select node-major [N,WW]", select_nm, win_nm, elig)
+    sel_wm = timeit("select word-major [WW,N]", select_wm, win_wm, elig)
+    timeit("wave roll+OR node-major", wave_nm, win_nm, sel_nm, ok, s)
+    timeit("wave roll+OR word-major", wave_wm, win_wm, sel_wm, ok, s)
+    timeit("column-select node-major", colsel_nm, win_nm, wcol)
+    timeit("column-select word-major", colsel_wm, win_wm, wcol)
+    # 14-wave composite: the full per-period wave traffic in each layout
+    def waves14_nm(win, sel):
+        for i in range(14):
+            win = wave_nm(win, sel, ok, 1000 + i)
+        return win
+
+    def waves14_wm(win, sel):
+        for i in range(14):
+            win = wave_wm(win, sel, ok, 1000 + i)
+        return win
+
+    timeit("14-wave composite node-major", waves14_nm, win_nm, sel_nm)
+    timeit("14-wave composite word-major", waves14_wm, win_wm, sel_wm)
+
+
+if __name__ == "__main__":
+    main()

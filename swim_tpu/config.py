@@ -75,6 +75,21 @@ class SwimConfig:
     #                              the paper's geometric e/(e−1) first-
     #                              detection law exactly (gather-based
     #                              delivery; vanilla protocol only).
+    ring_sel_scope: str = "wave"  # piggyback-selection freshness (rotor):
+    #                              "wave" re-selects before every message
+    #                              wave, so acks relay rumors learned
+    #                              earlier in the SAME period (exact SWIM
+    #                              semantics; 14 full window passes per
+    #                              period at k=3). "period" selects once
+    #                              from start-of-period knowledge and
+    #                              reuses it for all waves — rumors
+    #                              learned mid-period relay from the next
+    #                              period on (deviation R5,
+    #                              docs/PROTOCOL.md), cutting the
+    #                              dominant HBM term (utils/roofline.py).
+    #                              Pull mode always selects once before
+    #                              any delivery; the knob is a no-op
+    #                              there.
 
     def __post_init__(self):
         if self.n_nodes < 2:
@@ -83,6 +98,8 @@ class SwimConfig:
             raise ValueError(f"bad target_selection {self.target_selection!r}")
         if self.ring_probe not in ("rotor", "pull"):
             raise ValueError(f"bad ring_probe {self.ring_probe!r}")
+        if self.ring_sel_scope not in ("wave", "period"):
+            raise ValueError(f"bad ring_sel_scope {self.ring_sel_scope!r}")
         if self.ring_probe == "pull" and self.lifeguard:
             raise ValueError(
                 "ring_probe='pull' supports the vanilla protocol only: "

@@ -76,6 +76,15 @@ its documented deviations) with these additional documented deviations:
       `overflow` — a dropped suspicion is re-detected by the next
       failed probe, so overload degrades into latency, never wrong
       state (same philosophy as the rumor engine's deviation 4).
+  R5. **Period-scope piggyback selection** (opt-in,
+      `cfg.ring_sel_scope == "period"`; default "wave" is exact).
+      Selection and buddy knowledge are evaluated once per period
+      against the start-of-period window instead of before every wave:
+      a rumor learned mid-period relays from the NEXT period on (one
+      extra period of dissemination latency per hop worst-case, no
+      state divergence otherwise).  Removes 2+4k−1 of the 2+4k
+      full-window selection passes — the dominant HBM term at 1M nodes
+      (utils/roofline.py).
 
 Join/churn: nodes with `FaultPlan.join_step > 0` are inert (no probing,
 no receiving, excluded from dissemination totals) until their join
@@ -374,32 +383,52 @@ def _select_first_b(win_masked, b: int):
 
 
 def _col_select_multi(mat: jax.Array, cols: list[jax.Array]) -> list[jax.Array]:
-    """[mat[i, c[i]] for c in cols], as ONE streamed pass over `mat`.
+    """[mat[i, c[i]] for c in cols], as one-hot masked reduces over `mat`.
 
     `mat[rows, col]` with per-row dynamic columns lowers to XLA's generic
     gather, which TPU executes near-serially (measured: 13–21 ms per
-    1M-row gather — the round-2 profile's entire hot set).  A fused
-    select loop over the static column count instead reads `mat` exactly
-    once at HBM bandwidth and serves every query in `cols` from the same
-    pass.  Each `c` must be pre-clamped into [0, mat.shape[1])."""
-    accs = [jnp.zeros(mat.shape[:1], mat.dtype) for _ in cols]
-    for w in range(mat.shape[1]):
-        cw = mat[:, w]
-        for j, c in enumerate(cols):
-            accs[j] = accs[j] | jnp.where(c == w, cw, jnp.zeros_like(cw))
-    return accs
+    1M-row gather — the round-2 profile's entire hot set).  A Python
+    loop of per-column slices is no better: XLA decomposes it into
+    dozens of strided slice fusions that each touch 1/lanes of every
+    tile of `mat` (the round-3 profile's entire hot set — ~119 GB of
+    effective traffic per period at 1M nodes).  A single max-reduce of
+    the one-hot-masked matrix instead reads `mat` exactly once, in its
+    native tiling, per query.  Out-of-range c yields 0 (same as the
+    pre-clamped contract)."""
+    w_ids = jnp.arange(mat.shape[1], dtype=jnp.int32)
+    zero = jnp.zeros((), mat.dtype)
+    c = jnp.stack([jnp.asarray(x) for x in cols])            # [Q, N]
+    hit = c[:, :, None] == w_ids[None, None, :]              # [Q, N, W]
+    out = jnp.max(jnp.where(hit, mat[None], zero), axis=2)   # [Q, N]
+    return [out[q] for q in range(len(cols))]
 
 
 def _row_select_multi(mat: jax.Array, rows: list[jax.Array]) -> list[jax.Array]:
     """[mat[r[i], i] for r in rows] over a WORD-major [W, N] matrix —
-    the `cold` twin of _col_select_multi; each streamed `mat[w]` read is
-    a contiguous row (the point of cold's word-major layout)."""
-    accs = [jnp.zeros(mat.shape[1:], mat.dtype) for _ in rows]
-    for w in range(mat.shape[0]):
-        cw = mat[w]
-        for j, r in enumerate(rows):
-            accs[j] = accs[j] | jnp.where(r == w, cw, jnp.zeros_like(cw))
-    return accs
+    the `cold` twin of _col_select_multi (same one-hot-reduce shape;
+    same rationale: a slice per word is a strided tile walk, a fused
+    masked reduce is one full-bandwidth pass per query)."""
+    w_ids = jnp.arange(mat.shape[0], dtype=jnp.int32)
+    zero = jnp.zeros((), mat.dtype)
+    r = jnp.stack([jnp.asarray(x) for x in rows])            # [Q, N]
+    hit = r[:, None, :] == w_ids[None, :, None]              # [Q, W, N]
+    out = jnp.max(jnp.where(hit, mat[None], zero), axis=1)   # [Q, N]
+    return [out[q] for q in range(len(rows))]
+
+
+def _lane_counts(words: jax.Array, active: jax.Array) -> jax.Array:
+    """i32[OW*32]: per-lane active-knower counts of OW packed words.
+
+    `words` is u32[OW, N] (word-major rows); lane la = w*32 + b counts
+    active nodes with bit b of word w set.  One fused reduce instead of
+    a Python loop of OB per-lane reductions (which XLA lowers to OB
+    separate strided passes)."""
+    ow = words.shape[0]
+    bit_ids = jnp.arange(WORD, dtype=jnp.uint32)[None, :, None]
+    bits = (words[:, None, :] >> bit_ids) & jnp.uint32(1)    # [OW, 32, N]
+    masked = jnp.where(active[None, None, :], bits,
+                       jnp.uint32(0)).astype(jnp.int32)
+    return jnp.sum(masked, axis=2).reshape(ow * WORD)
 
 
 def resolved_words(cfg: SwimConfig, state: RingState) -> jax.Array:
@@ -538,11 +567,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
 
     # ---- Phase 0a: judge the outgoing words (entry win cols [0, OW)) ------
     out_cols = state.win[:, :g.ow]                             # u32[N, OW]
-    out_knowers = ops.gsum(jnp.stack(
-        [jnp.sum(jnp.where(
-            active, (out_cols[:, la // WORD] >> jnp.uint32(la % WORD))
-            & jnp.uint32(1), jnp.uint32(0))).astype(jnp.int32)
-         for la in range(ob)]))                                # i32[OB]
+    out_knowers = ops.gsum(_lane_counts(out_cols.T, active))   # i32[OB]
     out_rcol = jnp.mod(entry_gw0 + lanes // WORD, g.rw)
     out_slots = out_rcol * WORD + lanes % WORD                 # i32[OB]
     out_sub = subject[out_slots]
@@ -583,14 +608,11 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     inv_sub = subject[fresh_slots]
     inv_used = inv_sub >= 0
     inv_key = rkey[fresh_slots]
-    inv_knowers = ops.gsum(jnp.stack(
-        [jnp.sum(jnp.where(
-            active,
-            (jax.lax.dynamic_index_in_dim(
-                cold, jnp.mod(fresh_gw0 + la // WORD, g.rw), axis=0,
-                keepdims=False) >> jnp.uint32(la % WORD)) & jnp.uint32(1),
-            jnp.uint32(0))).astype(jnp.int32)
-         for la in range(ob)]))
+    fresh_rows = _row_select_multi(
+        cold, [jnp.broadcast_to(jnp.mod(fresh_gw0 + w, g.rw),
+                                cold.shape[1:])
+               for w in range(g.ow)])                  # OW x u32[N]
+    inv_knowers = ops.gsum(_lane_counts(jnp.stack(fresh_rows), active))
     inv_tomb = inv_used & (inv_knowers >= live_total)
     gone_key = ops.scatter_max(gone_key, jnp.where(inv_tomb, inv_sub, n),
                                inv_key)
@@ -626,10 +648,14 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
          for w in range(g.ow)]).astype(jnp.uint32)             # u32[OW]
 
     # ---- Phase 0d: flush out cols to cold, shift window, carry bits -------
-    # (cold is word-major, so each flush is ONE contiguous row write)
+    # One fused full-matrix select instead of OW dynamic row updates: a
+    # single-row update of the [RW, N] matrix is a strided read-modify-
+    # write of every tile (measured ~7 ms each at 1M), while the fused
+    # where-pass streams cold once at HBM bandwidth.
+    row_ids = jnp.arange(g.rw, dtype=jnp.int32)[:, None]       # [RW, 1]
     for w in range(g.ow):
-        cold = jax.lax.dynamic_update_index_in_dim(
-            cold, state.win[:, w], jnp.mod(entry_gw0 + w, g.rw), axis=0)
+        cold = jnp.where(row_ids == jnp.mod(entry_gw0 + w, g.rw),
+                         state.win[:, w][None, :], cold)
     fresh_cols = out_cols & carry_mask[None, :]                # u32[N, OW]
     win = jnp.concatenate([state.win[:, g.ow:], fresh_cols], axis=1)
     first_gw = entry_gw0 + g.ow        # win col 0's global word, post-shift
@@ -701,8 +727,30 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         elig, jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)[None, :],
         jnp.uint32(0)), axis=1)                                # u32[WW]
 
+    # Piggyback-selection freshness (deviation R5): in "wave" scope the
+    # selection pass re-runs against the LIVE window before every wave
+    # (exact SWIM: an ack can relay a rumor its sender learned earlier in
+    # the same period).  In "period" scope both the first-B selection and
+    # the buddy/forced-bit knowledge are evaluated ONCE against the
+    # start-of-period window (`sel_src` binds `win` before any wave
+    # delivery) and reused by all 2+4k waves — deliveries still
+    # accumulate into `win` per wave, so end-of-period state sees
+    # everything; only the RELAY of mid-period knowledge waits for the
+    # next period.  This removes 2+4k−1 full `_select_first_b` window
+    # passes from the hot path (utils/roofline.py "waves" term).
+    period_scope = cfg.ring_sel_scope == "period"
+    sel_src = win                      # start-of-period window snapshot
+    if period_scope:
+        sel_base = _select_first_b(sel_src & elig_mask[None, :], b_pig)
+
     def sel_now(forced):
+        if period_scope:
+            return sel_base | forced
         return _select_first_b(win & elig_mask[None, :], b_pig) | forced
+
+    def sel_win():
+        """The window senders consult for piggyback/buddy knowledge."""
+        return sel_src if period_scope else win
 
     no_force = ops.zeros_nodes(jnp.uint32, g.ww)
     lha = state.lha
@@ -728,7 +776,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                 return no_force
             slot = roll_from(sus_slot, d)
             in_win, wcol, _, bit = slot_pos(slot)
-            (wword,) = _col_select_multi(win, [wcol])
+            (wword,) = _col_select_multi(sel_win(), [wcol])
             kn = (slot >= 0) & (((wword >> bit) & 1) > 0)
             usebit = kn & in_win
             onehot_w = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]

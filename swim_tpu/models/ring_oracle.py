@@ -216,6 +216,15 @@ class RingOracle:
             for b in range(WORD):
                 window_slots.append(col * WORD + b)
 
+        # Deviation R5 (docs/PROTOCOL.md): in "period" selection scope the
+        # piggyback selection AND buddy knowledge are evaluated against a
+        # start-of-period snapshot of the heard-bits; deliveries still
+        # write st.knows live.  In "wave" scope sel_knows aliases st.knows,
+        # so every wave's selection sees earlier waves' deliveries (exact
+        # SWIM semantics).
+        sel_knows = (st.knows.copy() if cfg.ring_sel_scope == "period"
+                     else st.knows)
+
         def select_b(node: int) -> list[int]:
             """First-B transmissible window slots known to node, newest
             word first, LSB first within a word."""
@@ -223,7 +232,7 @@ class RingOracle:
             for w in range(g.ww - 1, -1, -1):
                 for b in range(WORD):
                     sl = window_slots[w * WORD + b]
-                    if (st.subject[sl] >= 0 and st.knows[node, sl]):
+                    if (st.subject[sl] >= 0 and sel_knows[node, sl]):
                         picked.append(sl)
                         if len(picked) >= min(cfg.max_piggyback,
                                               g.ww * WORD):
@@ -234,7 +243,8 @@ class RingOracle:
             if not (cfg.lifeguard and cfg.buddy):
                 return []
             e = sus_best.get(subj)
-            if e and knows_bit(node, e[1]) and e[1] in window_slots:
+            if (e and e[1] >= 0 and bool(sel_knows[node, e[1]])
+                    and e[1] in window_slots):
                 return [e[1]]
             return []
 

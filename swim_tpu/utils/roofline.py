@@ -55,12 +55,15 @@ def ring_traffic(cfg: SwimConfig) -> dict[str, Any]:
     waves = 2 + 4 * k                     # W1..W2 + k×(W3..W6)
     terms: dict[str, tuple[float, float]] = {}
 
-    # Phase 0: window shift (read+write win), OW cold-row flushes
-    # (write) + OW cold-row reads for the invalidation census, and the
-    # outgoing-column lane census (reads win[:, :OW]).
+    # Phase 0: window shift (read+write win); the cold flush is a fused
+    # full-matrix where-pass (read+write cold — a row-granular update
+    # cannot lower to anything cheaper without strided tile walks, see
+    # ring.py Phase 0d); the invalidation census streams cold once more
+    # (_row_select_multi) plus the lane-count reduce; the outgoing-column
+    # census reads win[:, :OW].
     terms["phase0_shift_flush"] = (
-        2 * win + 2 * g.ow * nvec + g.ow * nvec,
-        2 * win + 2 * g.ow * nvec + 2 * g.ow * nvec)
+        2 * win + 3 * cold + 3 * g.ow * nvec,
+        2 * win + (2 + 2 * g.ow) * cold + 4 * g.ow * nvec)
 
     # Top-C per-subject index: C rounds of scatter_max/gather pairs over
     # node vectors (bk, bs) — ~4 nvec passes per round fused.
@@ -73,20 +76,31 @@ def ring_traffic(cfg: SwimConfig) -> dict[str, Any]:
     # XLA cannot fuse across the roll's data movement, so 2 R/W pairs
     # of win-sized arrays is the floor; unfused is 3 pairs plus the
     # extra win read in the OR.
-    terms["waves"] = (waves * (4 * win), waves * (7 * win))
+    #
+    # ring_sel_scope="period" (deviation R5) runs the selection pass
+    # ONCE: each wave is then roll(sel_base) + OR-update (read rolled
+    # sel + read/write win = 3 win-passes fused), plus a single 2-pass
+    # selection up front.
+    if cfg.ring_sel_scope == "period":
+        terms["waves"] = (2 * win + waves * (3 * win),
+                          3 * win + waves * (5 * win))
+    else:
+        terms["waves"] = (waves * (4 * win), waves * (7 * win))
 
     # Per-wave bool/float node-vector plumbing (wave_ok: rolls of send
     # flags, partition ids, loss uniforms — ~4 nvec per wave fused).
     terms["wave_vectors"] = (waves * 4 * nvec, waves * 8 * nvec)
 
-    # Buddy forced-bit passes (2 calls, rotor+lifeguard): one win
-    # column-select pass each.
-    buddy = 2 if (cfg.lifeguard and cfg.buddy) else 0
+    # Buddy forced-bit passes (rotor+lifeguard: one for W1 plus one per
+    # indirect round's W4): one win column-select pass each.
+    buddy = (1 + k) if (cfg.lifeguard and cfg.buddy) else 0
     terms["buddy_bits"] = (buddy * win, buddy * 2 * win)
 
     # Fused view/self query: one streamed pass over win (column-select)
-    # and ONE over cold (row-select) serving all C+1 queries.
-    terms["query_pass"] = (win + cold, win + cold + (g.c + 1) * 2 * nvec)
+    # and one over cold serving all C+1 queries when XLA shares the
+    # broadcast read (fused bracket); per-query cold reads otherwise.
+    terms["query_pass"] = (win + cold,
+                           win + (g.c + 1) * cold + (g.c + 1) * 2 * nvec)
 
     # Phase C/D: suspicion vectors, first-true top_k compactions,
     # origination scatters — all nvec-scale (~12 passes fused).

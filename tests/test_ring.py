@@ -123,6 +123,37 @@ class TestBitwiseVsOracle:
             faults.with_crashes(faults.none(n), [3, 11, 17], [1]), 0.25)
         orc, _ = run_both(cfg, plan, 14, seed=5)
 
+    def test_period_sel_scope_lifecycle(self):
+        """ring_sel_scope='period' (deviation R5): start-of-period
+        selection snapshot, full crash lifecycle — bitwise."""
+        n = 32
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period")
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5], [2]), 0.06)
+        orc, _ = run_both(cfg, plan, 26, seed=11)
+        assert key_status(int(orc.gone_key[5])) == Status.DEAD
+
+    def test_period_sel_scope_differs_from_wave(self):
+        """The scopes are genuinely different semantics (otherwise the
+        R5 test above would be vacuous).  Loss is required: at zero loss
+        the rotor's relay paths are degenerate — W2 acks return to the
+        node that just sent the payload, and W3→W6 only fire for probers
+        of crashed (undeliverable) targets — so only a lossy run lets a
+        proxy relay mid-period knowledge to a live receiver."""
+        n = 32
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 11], [2]), 0.2)
+        key = jax.random.key(11)
+        states, diverged = {}, False
+        for scope in ("wave", "period"):
+            cfg = SwimConfig(n_nodes=n, ring_sel_scope=scope)
+            est = ring.init_state(cfg)
+            step = jax.jit(lambda s, r, c=cfg: ring.step(c, s, plan, r))
+            for t in range(8):
+                est = step(est, ring.draw_period_ring(key, t, cfg))
+            states[scope] = np.asarray(est.win)
+        assert not np.array_equal(states["wave"], states["period"])
+
 
 class TestConfigSweep:
     """Bitwise engine/oracle parity across the GEOMETRY space — ring
@@ -144,6 +175,12 @@ class TestConfigSweep:
              lifeguard=True),
         dict(n_nodes=32, ring_orig_words=3, ring_window_periods=2,
              ring_view_c=2, k_indirect=1, ring_probe="pull"),
+        dict(n_nodes=48, ring_orig_words=2, ring_window_periods=3,
+             ring_view_c=2, k_indirect=2, ring_sel_scope="period",
+             lifeguard=True),
+        dict(n_nodes=24, ring_orig_words=1, ring_window_periods=2,
+             ring_view_c=2, k_indirect=1, ring_sel_scope="period",
+             max_piggyback=3),
     ]
 
     def test_geometry_sweep(self):

@@ -84,6 +84,15 @@ class TestBitwiseVsGlobal:
                                      3, 9)
         run_both(cfg, plan, 14, seed=5)
 
+    def test_period_sel_scope(self):
+        """ring_sel_scope='period' (deviation R5) under loss + crash:
+        the once-per-period selection stays bitwise across the mesh."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period", **SMALL_GEOM)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 40], [2, 6]), 0.1)
+        run_both(cfg, plan, 16, seed=9)
+
     def test_run_scan_matches_stepwise(self):
         """build_run's fused scan == ring.run (same in-scan randomness)."""
         n = 64

@@ -8,9 +8,18 @@ the engine's three hot window patterns in both layouts at the 1M-node
 default geometry, so the layout decision is made from measured numbers.
 
 Patterns (per models/ring.py):
-  select  — `_select_first_b`-shaped: WW x B lowest-set-bit loop
+  select  — the engine's `_select_first_b` (imported, not copied), with
+            the eligibility mask pre-applied
   wave    — roll along the node axis + OR-update into win (one wave)
   colsel  — per-row window-column select (`_col_select_multi`, one query)
+
+TUNNEL HAZARDS (docs/RESULTS.md §1b): every rep perturbs one input (so
+the axon tunnel's identical-dispatch result cache cannot serve a
+repeat) and the timing barrier is a host fetch of an output element
+(bare `block_until_ready` returns at enqueue for some executables).
+Even so, single-op rows remain dominated by the ~66 ms fixed dispatch
+latency — only the relative composite rows are meaningful over the
+tunnel; absolute per-op numbers need a local backend.
 
 Usage: python scripts/microbench_layout.py [N] [reps]
 """
@@ -24,6 +33,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from swim_tpu.models.ring import _select_first_b
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
 REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 10
@@ -31,33 +43,34 @@ WW, B = 12, 6
 
 
 def timeit(name, fn, *args):
-    fn_j = jax.jit(fn)
-    jax.block_until_ready(fn_j(*args))
+    """Time REPS dispatches; arg 0 is XORed with the rep index so no
+    two dispatches are identical (tunnel cache defense), and the
+    barrier is a host fetch of one output element (enqueue-return
+    defense)."""
+    fn_j = jax.jit(lambda salt, *a: fn(a[0] ^ salt, *a[1:]))
+
+    def once(i):
+        out = fn_j(jnp.uint32(i), *args)
+        leaf = jax.tree.leaves(out)[0]
+        np.asarray(jax.device_get(leaf)).ravel()[:1]
+        return out
+
+    once(0)
     t0 = time.perf_counter()
-    for _ in range(REPS):
-        out = jax.block_until_ready(fn_j(*args))
+    for i in range(1, REPS + 1):
+        out = once(i)
     dt = (time.perf_counter() - t0) / REPS
     print(f"{name:48s} {dt * 1e3:8.3f} ms", flush=True)
     return out
 
 
 def select_nm(win, elig):                    # node-major [N, WW]
-    budget = jnp.full((N,), B, jnp.int32)
-    taken = [None] * WW
-    for w in range(WW - 1, -1, -1):
-        m = win[:, w] & elig[w]
-        acc = jnp.zeros_like(m)
-        for _ in range(B):
-            low = m & (jnp.uint32(0) - m)
-            bitm = jnp.where(budget > 0, low, jnp.uint32(0))
-            acc = acc | bitm
-            m = m ^ bitm
-            budget = budget - (bitm != 0).astype(jnp.int32)
-        taken[w] = acc
-    return jnp.stack(taken, axis=-1)
+    return _select_first_b(win & elig[None, :], B)
 
 
 def select_wm(win, elig):                    # word-major [WW, N]
+    # word-major twin of ring._select_first_b (the engine has no
+    # word-major selector to import; keep in sync with it)
     budget = jnp.full((N,), B, jnp.int32)
     taken = [None] * WW
     for w in range(WW - 1, -1, -1):

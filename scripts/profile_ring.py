@@ -2,6 +2,7 @@
 
 Usage: python scripts/profile_ring.py [N] [--periods P] [--trace DIR]
                                       [--probe rotor|pull] [--top K]
+                                      [--sel-scope wave|period]
 
 Times a jitted multi-period run, then (with --trace) writes a
 jax.profiler trace and prints the top-K XLA ops by self time parsed
@@ -36,6 +37,7 @@ def opt(name, default=None):
 trace_dir = opt("--trace")
 periods = int(opt("--periods", "5"))
 probe = opt("--probe", "rotor")
+sel_scope = opt("--sel-scope", "wave")
 top_k = int(opt("--top", "25"))
 n = int(args[0]) if args else 1_000_000
 
@@ -43,7 +45,7 @@ from swim_tpu import SwimConfig
 from swim_tpu.models import ring
 from swim_tpu.sim import faults
 
-cfg = SwimConfig(n_nodes=n, ring_probe=probe)
+cfg = SwimConfig(n_nodes=n, ring_probe=probe, ring_sel_scope=sel_scope)
 plan = faults.with_random_crashes(
     faults.none(n), jax.random.key(1), 0.001, 0, periods)
 state = ring.init_state(cfg)

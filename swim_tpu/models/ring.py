@@ -416,6 +416,28 @@ def _row_select_multi(mat: jax.Array, rows: list[jax.Array]) -> list[jax.Array]:
     return [out[q] for q in range(len(rows))]
 
 
+def _top_k_vals(x: jax.Array, k: int) -> jax.Array:
+    """Top-k VALUES of a 1-D array, descending — exact, values-only.
+
+    Hierarchical: block-wise top_k then a merge top_k over the block
+    winners.  XLA lowers a single lax.top_k on a very long axis to a
+    full sort (measured: 1.26 ms per [1M] top_k at k=64 on v5 lite);
+    the block form sorts 4096-element rows instead.  Returns exactly
+    lax.top_k's values (ties are indistinguishable by value; callers
+    must not need indices)."""
+    n, block = x.shape[0], 4096
+    if n <= 4 * block or k > block:
+        return jax.lax.top_k(x, min(k, n))[0]
+    nb = -(-n // block)
+    fill = jnp.asarray(jnp.iinfo(x.dtype).min
+                       if jnp.issubdtype(x.dtype, jnp.integer)
+                       else -jnp.inf, x.dtype)
+    xp = jnp.concatenate(
+        [x, jnp.full((nb * block - n,), fill, x.dtype)])
+    vb = jax.lax.top_k(xp.reshape(nb, block), k)[0]              # [nb, k]
+    return jax.lax.top_k(vb.reshape(-1), k)[0]
+
+
 def _lane_counts(words: jax.Array, active: jax.Array) -> jax.Array:
     """i32[OW*32]: per-lane active-knower counts of OW packed words.
 
@@ -518,7 +540,7 @@ class GlobalOps:
         """Ascending global ids of the first k True entries of a
         node-axis bool vector; missing entries fill with n."""
         key = jnp.where(valid, self.n - self.ids(), 0)
-        kk, _ = jax.lax.top_k(key, min(k, self.n))
+        kk = _top_k_vals(key, min(k, self.n))
         idx = jnp.where(kk > 0, self.n - kk, self.n)
         if k > self.n:
             idx = jnp.concatenate(

@@ -185,7 +185,7 @@ class ShardOps:
     def first_true_nodes(self, valid, k):
         gk = jnp.where(valid, self.n - self.ids(), 0)
         kl = min(k, self.s)
-        kk, _ = jax.lax.top_k(gk, kl)
+        kk = ring._top_k_vals(gk, kl)
         merged = jax.lax.all_gather(kk, AXIS).reshape(-1)   # [D * kl]
         kk2, _ = jax.lax.top_k(merged, min(k, self.d * kl))
         idx = jnp.where(kk2 > 0, self.n - kk2, self.n)

@@ -144,7 +144,7 @@ class TestBitwiseVsOracle:
         plan = faults.with_loss(
             faults.with_crashes(faults.none(n), [5, 11], [2]), 0.2)
         key = jax.random.key(11)
-        states, diverged = {}, False
+        states = {}
         for scope in ("wave", "period"):
             cfg = SwimConfig(n_nodes=n, ring_sel_scope=scope)
             est = ring.init_state(cfg)

@@ -1,0 +1,104 @@
+"""Throughput-geometry ablation at 1M nodes: how far do the protocol
+knobs take one chip toward the 10k periods/sec north star?
+
+Each arm is a legitimate SWIM operating point (every knob is a config
+field a user sets; nothing here changes engine semantics), measured
+with the same defended harness as bench.py (distinct seed per dispatch,
+host-fetch barrier, step-advance proof).  Arms:
+
+  default   — the bench flagship geometry (lambda=5, k=3, WW=12,
+              RW=128, C=3, wave-scope selection)
+  period    — + ring_sel_scope="period" (deviation R5)
+  lean      — + lambda=2 (the 1M sweep's own finding: past lambda=2
+              the timeout is not the binding constraint at low loss —
+              docs/RESULTS.md 5a), retransmit_mult=2, k=1, window 3
+              periods, C=2: WW=6, RW=28 words — shorter gossip window,
+              weaker indirect probing, smaller rumor ring (overflow is
+              counted, never silent)
+
+Prints one JSON line per arm; writes bench_results/geometry_ablation.json.
+
+Usage: python scripts/geometry_ablation.py [N] [periods]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+PERIODS = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+
+ARMS = {
+    "default": {},
+    "period": dict(ring_sel_scope="period"),
+    "lean": dict(ring_sel_scope="period", suspicion_mult=2.0,
+                 retransmit_mult=2.0, k_indirect=1,
+                 ring_window_periods=3, ring_view_c=2),
+}
+
+
+def measure(name: str, kw: dict) -> dict:
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import ring
+    from swim_tpu.sim import faults
+    from swim_tpu.utils import roofline as rl
+
+    cfg = SwimConfig(n_nodes=N, **kw)
+    g = ring.geometry(cfg)
+    plan = faults.with_random_crashes(
+        faults.none(N), jax.random.key(1), 0.001, 0, PERIODS)
+    state = ring.init_state(cfg)
+    key = jax.random.key(0)
+    run = jax.jit(lambda st, seed: ring.run(
+        cfg, st, plan, jax.random.fold_in(key, seed), PERIODS))
+
+    def once(i):
+        out = run(state, jnp.int32(i))
+        jax.block_until_ready(out)
+        assert int(out.step) == PERIODS       # fetch barrier + proof
+        return out
+
+    t0 = time.perf_counter()
+    once(0)
+    compile_s = time.perf_counter() - t0
+    once(1)
+    t0 = time.perf_counter()
+    out = once(2)
+    pps = PERIODS / (time.perf_counter() - t0)
+    ceil = rl.ceiling_periods_per_sec(cfg)
+    res = {
+        "arm": name, "n": N, "periods": PERIODS,
+        "periods_per_sec": round(pps, 2),
+        "overflow": int(out.overflow),
+        "geometry": {"ww": g.ww, "rw": g.rw, "c": g.c,
+                     "k": cfg.k_indirect,
+                     "sel_scope": cfg.ring_sel_scope,
+                     "suspicion_mult": cfg.suspicion_mult},
+        "ceiling_fused_pps": round(ceil["ceiling_fused"], 1),
+        "roofline_fraction": round(pps / ceil["ceiling_fused"], 4),
+        "compile_s": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+    }
+    print(json.dumps(res), flush=True)
+    return res
+
+
+def main():
+    out = [measure(name, kw) for name, kw in ARMS.items()]
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_results",
+        "geometry_ablation.json")
+    with open(path, "w") as f:
+        json.dump({"n": N, "periods": PERIODS, "arms": out}, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

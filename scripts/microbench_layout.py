@@ -52,7 +52,9 @@ def timeit(name, fn, *args):
     def once(i):
         out = fn_j(jnp.uint32(i), *args)
         leaf = jax.tree.leaves(out)[0]
-        np.asarray(jax.device_get(leaf)).ravel()[:1]
+        # single-ELEMENT fetch: slice on device first, so the barrier
+        # transfers 4 bytes, not the whole array
+        np.asarray(leaf.ravel()[0])
         return out
 
     once(0)

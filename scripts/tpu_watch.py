@@ -77,6 +77,14 @@ def run_save(name: str, cmd: list[str], timeout: float) -> bool:
 CAPTURES: list[tuple[str, list[str], float, bool]] = [
     # (name, cmd tail, timeout, required-for-completion)
     ("bench_all", ["bench.py", "--tier", "all"], 3600, True),
+    # Throughput-geometry ablation (default / period-scope / lean arms
+    # at 1M nodes — the measured evidence for RESULTS.md's
+    # geometry-vs-ceiling analysis).
+    # (capture name differs from the script's own output file
+    # bench_results/geometry_ablation.json so run_save's wrapper does
+    # not clobber the full 3-arm artifact)
+    ("geometry_ablation_run",
+     ["scripts/geometry_ablation.py", "1000000", "50"], 2400, False),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

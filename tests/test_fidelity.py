@@ -183,3 +183,30 @@ class TestFalsePositiveSuppression:
             self._run(loss, lifeguard=True).series.false_dead_views).max())
         assert fp_vanilla > 10_000, fp_vanilla     # meltdown is real
         assert fp_lifeguard < fp_vanilla / 3, (fp_lifeguard, fp_vanilla)
+
+    def test_lifeguard_suppression_cross_engine(self):
+        """The ring engine's INDEPENDENT dynamic-suspicion/LHA/buddy
+        implementation (sentinel timers over the packed ring table,
+        bitwise-pinned against models/ring_oracle.py) reproduces the
+        rumor engine's config-5 claim above: under supercritical loss,
+        the Lifeguard arm multiplies false-DEAD views down vs vanilla.
+        The dense engine deliberately carries no dynamic arm
+        (docs/PROTOCOL.md §6: per-pair state cannot track sentinel
+        originators), so THIS pair — two engines, two scalar gold
+        standards — is the cross-engine check for config 5."""
+        from swim_tpu.models import ring
+
+        loss = 0.1
+
+        def ring_fp(lifeguard: bool) -> int:
+            cfg = SwimConfig(n_nodes=FP_N, lifeguard=lifeguard)
+            plan = faults.with_loss(faults.none(FP_N), loss)
+            res = runner.run_study_ring(
+                cfg, ring.init_state(cfg), plan, jax.random.key(3),
+                FP_PERIODS)
+            return int(np.asarray(res.series.false_dead_views).max())
+
+        fp_vanilla = ring_fp(False)
+        fp_lifeguard = ring_fp(True)
+        assert fp_vanilla > 1_000, fp_vanilla      # overload regime hit
+        assert fp_lifeguard < fp_vanilla / 3, (fp_lifeguard, fp_vanilla)

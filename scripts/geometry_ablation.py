@@ -12,11 +12,16 @@ host-fetch barrier, step-advance proof).  Arms:
   lean      — + lambda=2 (the 1M sweep's own finding: past lambda=2
               the timeout is not the binding constraint at low loss —
               docs/RESULTS.md 5a), retransmit_mult=2, k=1, window 3
-              periods, C=2: WW=6, RW=28 words — shorter gossip window,
-              weaker indirect probing, smaller rumor ring (overflow is
-              counted, never silent)
+              periods, C=2: WW=6, RW=56 words at 1M (geometry() sizes
+              the ring from the slowest-resolving timer) — shorter
+              gossip window, weaker indirect probing, smaller rumor
+              ring (overflow is counted, never silent)
 
-Prints one JSON line per arm; writes bench_results/geometry_ablation.json.
+Timing reuses bench.py's defended harness (_time_run: distinct seed
+per dispatch, host-fetch barrier, step-advance proof) plus the same
+3x-roofline plausibility guard.  The LAST stdout line is the full
+summary JSON (so tpu_watch's wrapper artifact is self-contained); the
+same summary is written to bench_results/geometry_ablation.json.
 
 Usage: python scripts/geometry_ablation.py [N] [periods]
 """
@@ -45,6 +50,7 @@ ARMS = {
 
 
 def measure(name: str, kw: dict) -> dict:
+    from bench import _time_run
     from swim_tpu import SwimConfig
     from swim_tpu.models import ring
     from swim_tpu.sim import faults
@@ -59,24 +65,23 @@ def measure(name: str, kw: dict) -> dict:
     run = jax.jit(lambda st, seed: ring.run(
         cfg, st, plan, jax.random.fold_in(key, seed), PERIODS))
 
-    def once(i):
-        out = run(state, jnp.int32(i))
-        jax.block_until_ready(out)
-        assert int(out.step) == PERIODS       # fetch barrier + proof
-        return out
-
     t0 = time.perf_counter()
-    once(0)
+    out0 = run(state, jnp.int32(99))
+    jax.block_until_ready(out0)
     compile_s = time.perf_counter() - t0
-    once(1)
-    t0 = time.perf_counter()
-    out = once(2)
-    pps = PERIODS / (time.perf_counter() - t0)
+    # bench.py's defended harness: distinct seed per dispatch,
+    # host-fetch barrier, step-advance execution proof
+    pps = _time_run(run, state, warmup=1, periods=PERIODS)
     ceil = rl.ceiling_periods_per_sec(cfg)
+    limit = 3.0 * ceil["ceiling_fused"]
+    if pps > limit:
+        raise RuntimeError(
+            f"{name}: measured {pps:.0f} p/s exceeds 3x the roofline "
+            f"ceiling ({limit:.0f}) — timing artifact")
     res = {
         "arm": name, "n": N, "periods": PERIODS,
         "periods_per_sec": round(pps, 2),
-        "overflow": int(out.overflow),
+        "overflow": int(out0.overflow),
         "geometry": {"ww": g.ww, "rw": g.rw, "c": g.c,
                      "k": cfg.k_indirect,
                      "sel_scope": cfg.ring_sel_scope,
@@ -92,12 +97,16 @@ def measure(name: str, kw: dict) -> dict:
 
 def main():
     out = [measure(name, kw) for name, kw in ARMS.items()]
+    summary = {"n": N, "periods": PERIODS, "arms": out}
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "bench_results",
         "geometry_ablation.json")
     with open(path, "w") as f:
-        json.dump({"n": N, "periods": PERIODS, "arms": out}, f, indent=1)
-    print(f"wrote {path}")
+        json.dump(summary, f, indent=1)
+    print(f"wrote {path}", file=sys.stderr)
+    # LAST stdout line = the full summary, so tpu_watch's last-JSON-line
+    # wrapper artifact is self-contained (all three arms, not just lean)
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

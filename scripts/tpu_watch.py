@@ -116,15 +116,22 @@ def main() -> int:
             for name, tail, tmo, required in CAPTURES:
                 if name in done:
                     continue
-                if run_save(name, [sys.executable] + tail, tmo) or \
-                        not required:
-                    done.add(name)  # completed (or best-effort) — keep it
+                if run_save(name, [sys.executable] + tail, tmo):
+                    done.add(name)
                 elif not probe():
-                    # Tunnel died mid-pass: don't burn hours running the
-                    # remaining long captures against a dead backend.
+                    # Tunnel died mid-pass (ANY capture, required or
+                    # not): don't burn hours running the remaining long
+                    # captures against a dead backend, and leave the
+                    # failed capture un-done so it retries at the next
+                    # recovery.
                     print("[tpu_watch] tunnel lost mid-capture; waiting",
                           flush=True)
                     break
+                elif not required:
+                    # Genuine (non-tunnel) failure of a best-effort
+                    # capture: record it done so it cannot retry-loop
+                    # forever ahead of the required studies.
+                    done.add(name)
             if {n for n, _, _, req in CAPTURES if req} <= done:
                 print("[tpu_watch] capture complete", flush=True)
                 return 0

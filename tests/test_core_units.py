@@ -207,3 +207,42 @@ class TestJoinSnapshot:
         total = sum(len(r.gossip) for r in replies)
         assert total == 601
         assert all(len(r.gossip) <= 255 for r in replies)
+
+
+class TestTopKVals:
+    """ring._top_k_vals must return exactly lax.top_k's values: the
+    hierarchical (block + merge) form is the TPU-fast path for the
+    [N]-sized candidate compactions, and first_true_nodes consumes its
+    values as ids — one dropped or reordered value would silently
+    reorder originations."""
+
+    def test_matches_lax_top_k(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu.models.ring import _top_k_vals
+
+        rng = np.random.default_rng(7)
+        for n in (5, 4096, 16384, 100_000, 1_000_000):
+            for k in (1, 64, 300):
+                # heavy ties (the first_true_nodes key distribution:
+                # mostly zeros, distinct positives)
+                x = np.where(rng.random(n) < 0.001,
+                             rng.integers(1, n + 1, n), 0).astype(np.int32)
+                a = np.asarray(_top_k_vals(jnp.asarray(x), min(k, n)))
+                b = np.asarray(jax.lax.top_k(jnp.asarray(x), min(k, n))[0])
+                np.testing.assert_array_equal(a, b, err_msg=f"n={n} k={k}")
+
+    def test_negative_values_and_full_k(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu.models.ring import _top_k_vals
+
+        x = np.random.default_rng(3).integers(-2**30, 2**30,
+                                              50_000).astype(np.int32)
+        a = np.asarray(_top_k_vals(jnp.asarray(x), 4096))
+        b = np.asarray(jax.lax.top_k(jnp.asarray(x), 4096)[0])
+        np.testing.assert_array_equal(a, b)

@@ -85,6 +85,15 @@ CAPTURES: list[tuple[str, list[str], float, bool]] = [
     # not clobber the full 3-arm artifact)
     ("geometry_ablation_run",
      ["scripts/geometry_ablation.py", "1000000", "50"], 2400, False),
+    # Beyond-1M scale probes: 4M (9.4 GB state+transients headroom) and
+    # 10M (5.9 GB state — near the single-chip HBM edge; validated at
+    # 4M on the CPU host, 10M is allowed to fail OOM and record it).
+    ("scale_4m",
+     ["bench.py", "--tier", "ringp", "--nodes", "4000000",
+      "--periods", "20", "--tier-timeout", "1500"], 1800, False),
+    ("scale_10m",
+     ["bench.py", "--tier", "ringp", "--nodes", "10000000",
+      "--periods", "10", "--tier-timeout", "1500"], 1800, False),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

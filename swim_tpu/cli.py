@@ -100,7 +100,8 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
 
     engine = experiments.pick_engine(args.nodes, args.engine)
     cfg = SwimConfig(n_nodes=args.nodes, suspicion_mult=args.suspicion_mult,
-                     lifeguard=args.lifeguard)
+                     lifeguard=args.lifeguard,
+                     ring_sel_scope=args.sel_scope)
     plan = faults.none(args.nodes)
     if args.loss:
         plan = faults.with_loss(plan, args.loss)
@@ -165,6 +166,11 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
         "periods_per_sec": round(args.periods / dt, 2),
         "crashed": int(crashed.sum()),
         "devices": len(jax.devices()),
+        # self-describing throughput numbers (same rationale as
+        # bench.py): a period-scope (deviation R5) run must never be
+        # quotable as an exact wave-scope one
+        **({"ring_sel_scope": cfg.ring_sel_scope}
+           if engine in ("ring", "ringshard") else {}),
     }
     if dead_views is not None:
         detected = (dead_views[np.ix_(live, crashed)].all(axis=0).sum()
@@ -263,6 +269,11 @@ def build_parser() -> argparse.ArgumentParser:
     sim.add_argument("--lifeguard", action="store_true")
     sim.add_argument("--engine", choices=ENGINES,
                      default="auto")
+    sim.add_argument("--sel-scope", choices=("wave", "period"),
+                     default="wave",
+                     help="ring piggyback-selection freshness (deviation "
+                          "R5: 'period' selects once per period from "
+                          "start-of-period state — the throughput mode)")
     sim.add_argument("--profile", default="",
                      help="write a jax.profiler device trace to this dir")
     sim.set_defaults(fn=_cmd_simulate)

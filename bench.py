@@ -428,12 +428,28 @@ def main() -> int:
              "all": ["dense", "rumor", "shard", "ring", "ringp",
                      "ringshard"]}.get(args.tier, [args.tier])
     results = {}
+    backend_dead = False
     for tier in tiers:
+        if backend_dead:
+            results[tier] = {"ok": False, "tier": tier,
+                             "error": "skipped: backend unresponsive "
+                                      "after an earlier tier timed out"}
+            continue
         nodes = n_d if tier == "dense" else n_r
         p = max(periods, 50) if (tier == "dense" and not args.smoke) \
             else periods
         results[tier] = run_tier(tier, platform, nodes, p,
                                  args.tier_timeout)
+        if on_tpu and "timed out" in str(results[tier].get("error", "")):
+            # A tier timing out on an accelerator usually means the axon
+            # tunnel died mid-run (observed: a relapse turned a 25-min
+            # capture into 6 x 1200 s of dead waiting).  Re-probe once;
+            # if the backend is gone, fail the remaining tiers fast so
+            # the JSON line still lands within the caller's budget.
+            probed, _ = probe_default_backend(args.probe_timeout)
+            if probed is None:
+                backend_dead = True
+                info["backend_died_after"] = tier
 
     # Headline: the best SCALABLE-engine number (ring/ringshard, then
     # shard/rumor, at headline N); dense is a fallback only when no

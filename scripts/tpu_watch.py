@@ -44,7 +44,8 @@ def probe(timeout: float = 120.0) -> bool:
         return False
 
 
-def run_save(name: str, cmd: list[str], timeout: float) -> bool:
+def run_save(name: str, cmd: list[str], timeout: float,
+             check=None) -> bool:
     print(f"[tpu_watch] running {name}: {' '.join(cmd)}", flush=True)
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
@@ -69,14 +70,34 @@ def run_save(name: str, cmd: list[str], timeout: float) -> bool:
                    "captured_at": time.strftime("%Y-%m-%d %H:%M:%S")},
                   f, indent=1)
     os.replace(tmp, final)
+    ok = r.returncode == 0 and payload is not None
+    if ok and check is not None and not check(payload):
+        # e.g. bench.py ALWAYS exits 0 with a JSON line — a CPU-fallback
+        # or all-tiers-failed run must not be recorded as a successful
+        # TPU capture (it would never be retried at the next recovery)
+        print(f"[tpu_watch] {name}: payload failed the capture check "
+              "(kept on disk, will retry)", flush=True)
+        ok = False
     print(f"[tpu_watch] {name}: rc={r.returncode} "
-          f"parsed={'yes' if payload else 'no'}", flush=True)
-    return r.returncode == 0 and payload is not None
+          f"parsed={'yes' if payload else 'no'} ok={ok}", flush=True)
+    return ok
 
 
-CAPTURES: list[tuple[str, list[str], float, bool]] = [
-    # (name, cmd tail, timeout, required-for-completion)
-    ("bench_all", ["bench.py", "--tier", "all"], 3600, True),
+def _bench_on_tpu(p: dict) -> bool:
+    """bench.py payload really ran on the accelerator and measured."""
+    return (p.get("platform") not in (None, "cpu")
+            and float(p.get("value", 0) or 0) > 0)
+
+
+def _ablation_on_tpu(p: dict) -> bool:
+    arms = p.get("arms") or []
+    return bool(arms) and all(a.get("platform") != "cpu" for a in arms)
+
+
+CAPTURES: list = [
+    # (name, cmd tail, timeout, required-for-completion, payload check)
+    ("bench_all", ["bench.py", "--tier", "all"], 3600, True,
+     _bench_on_tpu),
     # Throughput-geometry ablation (default / period-scope / lean arms
     # at 1M nodes — the measured evidence for RESULTS.md's
     # geometry-vs-ceiling analysis).
@@ -84,32 +105,37 @@ CAPTURES: list[tuple[str, list[str], float, bool]] = [
     # bench_results/geometry_ablation.json so run_save's wrapper does
     # not clobber the full 3-arm artifact)
     ("geometry_ablation_run",
-     ["scripts/geometry_ablation.py", "1000000", "50"], 2400, False),
+     ["scripts/geometry_ablation.py", "1000000", "50"], 2400, False,
+     _ablation_on_tpu),
     # Beyond-1M scale probes: 4M (9.4 GB state+transients headroom) and
     # 10M (5.9 GB state — near the single-chip HBM edge; validated at
     # 4M on the CPU host, 10M is allowed to fail OOM and record it).
     ("scale_4m",
      ["bench.py", "--tier", "ringp", "--nodes", "4000000",
-      "--periods", "20", "--tier-timeout", "1500"], 1800, False),
+      "--periods", "20", "--tier-timeout", "1500"], 1800, False,
+     _bench_on_tpu),
+    # 10M may legitimately OOM — record whatever happened, done on any
+    # non-CPU attempt (value 0 + a TPU platform is an honest OOM record)
     ("scale_10m",
      ["bench.py", "--tier", "ringp", "--nodes", "10000000",
-      "--periods", "10", "--tier-timeout", "1500"], 1800, False),
+      "--periods", "10", "--tier-timeout", "1500"], 1800, False,
+     lambda p: p.get("platform") not in (None, "cpu")),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",
-      "--trace", "/tmp/tr_r3"], 1800, False),
+      "--trace", "/tmp/tr_r3"], 1800, False, None),
     # Real λ sweep (BASELINE config 4): 5 multipliers × 2 loss rates = 10
     # full 1M-node 100-period runs — budget accordingly.
     ("study_suspicion_1m",
      ["-m", "swim_tpu.cli", "study", "suspicion_sweep", "--nodes",
       "1000000", "--engine", "ring", "--periods", "100",
       "--mults", "1.0", "2.0", "3.0", "4.0", "6.0",
-      "--losses", "0.02", "0.05"], 10800, True),
+      "--losses", "0.02", "0.05"], 10800, True, None),
     # 4 arms (vanilla/lifeguard × OB 64/256): budget-vs-LHA attribution
     ("study_lifeguard_1m",
      ["-m", "swim_tpu.cli", "study", "lifeguard", "--nodes", "1000000",
       "--engine", "ring", "--periods", "100", "--budget-arms"], 7200,
-     True),
+     True, None),
 ]
 
 
@@ -122,10 +148,10 @@ def main() -> int:
     while time.time() < deadline:
         if probe():
             print("[tpu_watch] TPU healthy — capturing", flush=True)
-            for name, tail, tmo, required in CAPTURES:
+            for name, tail, tmo, required, check in CAPTURES:
                 if name in done:
                     continue
-                if run_save(name, [sys.executable] + tail, tmo):
+                if run_save(name, [sys.executable] + tail, tmo, check):
                     done.add(name)
                 elif not probe():
                     # Tunnel died mid-pass (ANY capture, required or
@@ -141,7 +167,7 @@ def main() -> int:
                     # capture: record it done so it cannot retry-loop
                     # forever ahead of the required studies.
                     done.add(name)
-            if {n for n, _, _, req in CAPTURES if req} <= done:
+            if {c[0] for c in CAPTURES if c[3]} <= done:
                 print("[tpu_watch] capture complete", flush=True)
                 return 0
             print("[tpu_watch] capture incomplete; will retry the "

@@ -447,7 +447,9 @@ def main() -> int:
             # if the backend is gone, fail the remaining tiers fast so
             # the JSON line still lands within the caller's budget.
             probed, _ = probe_default_backend(args.probe_timeout)
-            if probed is None:
+            if probed in (None, "cpu"):
+                # hung OR fell back to CPU — either way the accelerator
+                # the run started on is gone (mirrors the initial probe)
                 backend_dead = True
                 info["backend_died_after"] = tier
 

@@ -191,11 +191,60 @@ def fig_suspicion_tradeoff():
     print(f"wrote {path} (from {os.path.basename(src)})")
 
 
+def fig_perf_sequence():
+    """Round-3 optimization sequence: measured protocol-periods/sec at
+    1M nodes on ONE TPU v5 lite chip after each profile-driven step
+    (docs/RESULTS.md §1; artifacts: bench_all.json round-3 capture,
+    flagship_tpu_r3.json).  Single series — magnitude over ordered
+    stages — so: bars, one hue, direct value labels, no legend; the
+    dotted line is the fused HBM roofline for the final (period-scope)
+    geometry, the honest single-chip ceiling."""
+    # The stage values are the round-3 HISTORICAL record — each number
+    # is tied to a specific commit and preserved in
+    # bench_results/{bench_all,flagship_tpu_r3}.json; they are
+    # deliberately frozen here (a recapture updates the artifacts and
+    # future-round tables, not this round's sequence).
+    stages = [
+        ("round-2\nbaseline", 2.83),
+        ("gathers\n→ rolls", 5.87),
+        ("strided-tile\nwalk fixes", 22.8),
+        ("+ period-scope\nselection (R5)", 48.2),
+        ("+ hierarchical\ntop-k", 52.2),
+    ]
+    ceiling = 176.2          # fused roofline, period-scope geometry @1M
+    fig, ax = plt.subplots(figsize=(6.4, 3.8), dpi=160)
+    fig.patch.set_facecolor(SURFACE)
+    style_axes(ax)
+    xs = np.arange(len(stages))
+    vals = [v for _, v in stages]
+    ax.bar(xs, vals, width=0.62, color=S1, zorder=3)
+    for x, v in zip(xs, vals):
+        ax.annotate(f"{v:g}", (x, v), textcoords="offset points",
+                    xytext=(0, 3), ha="center", fontsize=9, color=INK2)
+    ax.axhline(ceiling, color=INK2, linewidth=0.9, linestyle=":")
+    ax.annotate("fused HBM roofline (period-scope geometry): "
+                f"{ceiling:g} p/s", (0.0, ceiling),
+                textcoords="offset points", xytext=(2, 4), ha="left",
+                fontsize=8.5, color=INK2)
+    ax.set_xticks(xs, [s for s, _ in stages], fontsize=8.5)
+    ax.set_ylim(0, ceiling * 1.12)
+    ax.set_ylabel("protocol-periods/sec @ 1M nodes", color=INK)
+    ax.set_title("Ring engine, one TPU v5 lite chip: 18.4× in round 3",
+                 color=INK, fontsize=11, loc="left")
+    fig.tight_layout()
+    path = os.path.join(OUT, "perf_sequence.png")
+    fig.savefig(path, facecolor=SURFACE)
+    print("wrote", path)
+
+
 if __name__ == "__main__":
     os.makedirs(OUT, exist_ok=True)
     if "--tradeoff-only" in sys.argv:
         fig_suspicion_tradeoff()
+    elif "--perf-only" in sys.argv:
+        fig_perf_sequence()
     else:
         fig_detection_cdf()
         fig_fp_suppression()
+        fig_perf_sequence()
         fig_suspicion_tradeoff()

@@ -74,10 +74,14 @@ def run_save(name: str, cmd: list[str], timeout: float,
     if ok and check is not None and not check(payload):
         # e.g. bench.py ALWAYS exits 0 with a JSON line — a CPU-fallback
         # or all-tiers-failed run must not be recorded as a successful
-        # TPU capture (it would never be retried at the next recovery)
+        # TPU capture (it would never be retried at the next recovery).
+        # None = "retryable": distinct from False (genuine failure) so
+        # main() never marks a check-failed best-effort capture done.
         print(f"[tpu_watch] {name}: payload failed the capture check "
               "(kept on disk, will retry)", flush=True)
-        ok = False
+        print(f"[tpu_watch] {name}: rc={r.returncode} parsed=yes "
+              "ok=retry", flush=True)
+        return None
     print(f"[tpu_watch] {name}: rc={r.returncode} "
           f"parsed={'yes' if payload else 'no'} ok={ok}", flush=True)
     return ok
@@ -151,7 +155,8 @@ def main() -> int:
             for name, tail, tmo, required, check in CAPTURES:
                 if name in done:
                     continue
-                if run_save(name, [sys.executable] + tail, tmo, check):
+                res = run_save(name, [sys.executable] + tail, tmo, check)
+                if res:
                     done.add(name)
                 elif not probe():
                     # Tunnel died mid-pass (ANY capture, required or
@@ -162,10 +167,12 @@ def main() -> int:
                     print("[tpu_watch] tunnel lost mid-capture; waiting",
                           flush=True)
                     break
-                elif not required:
-                    # Genuine (non-tunnel) failure of a best-effort
-                    # capture: record it done so it cannot retry-loop
-                    # forever ahead of the required studies.
+                elif res is False and not required:
+                    # Genuine (non-tunnel, non-check) failure of a
+                    # best-effort capture: record it done so it cannot
+                    # retry-loop forever ahead of the required studies.
+                    # (res is None = payload check failed, e.g. a
+                    # CPU-fallback run — retryable, stays un-done.)
                     done.add(name)
             if {c[0] for c in CAPTURES if c[3]} <= done:
                 print("[tpu_watch] capture complete", flush=True)

@@ -45,7 +45,7 @@ def probe(timeout: float = 120.0) -> bool:
 
 
 def run_save(name: str, cmd: list[str], timeout: float,
-             check=None) -> bool:
+             check=None) -> bool | None:
     print(f"[tpu_watch] running {name}: {' '.join(cmd)}", flush=True)
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
@@ -149,6 +149,7 @@ def main() -> int:
         max_hours = float(sys.argv[sys.argv.index("--max-hours") + 1])
     deadline = time.time() + max_hours * 3600
     done: set[str] = set()
+    check_fails: dict[str, int] = {}
     while time.time() < deadline:
         if probe():
             print("[tpu_watch] TPU healthy — capturing", flush=True)
@@ -171,9 +172,20 @@ def main() -> int:
                     # Genuine (non-tunnel, non-check) failure of a
                     # best-effort capture: record it done so it cannot
                     # retry-loop forever ahead of the required studies.
-                    # (res is None = payload check failed, e.g. a
-                    # CPU-fallback run — retryable, stays un-done.)
                     done.add(name)
+                elif res is None and not required:
+                    # Payload check failed (e.g. a CPU-fallback run):
+                    # retryable ONCE — a best-effort capture that fails
+                    # its check twice with a healthy tunnel is a
+                    # deterministic failure (an honest value-0 TPU run,
+                    # a repeatable compile error) and must not keep
+                    # burning its timeout ahead of the required studies.
+                    check_fails[name] = check_fails.get(name, 0) + 1
+                    if check_fails[name] >= 2:
+                        print(f"[tpu_watch] {name}: check failed "
+                              f"{check_fails[name]}x — giving up on it",
+                              flush=True)
+                        done.add(name)
             if {c[0] for c in CAPTURES if c[3]} <= done:
                 print("[tpu_watch] capture complete", flush=True)
                 return 0

@@ -74,17 +74,29 @@ def run_save(name: str, cmd: list[str], timeout: float,
     if ok and check is not None and not check(payload):
         # e.g. bench.py ALWAYS exits 0 with a JSON line — a CPU-fallback
         # or all-tiers-failed run must not be recorded as a successful
-        # TPU capture (it would never be retried at the next recovery).
-        # None = "retryable": distinct from False (genuine failure) so
-        # main() never marks a check-failed best-effort capture done.
-        print(f"[tpu_watch] {name}: payload failed the capture check "
-              "(kept on disk, will retry)", flush=True)
+        # TPU capture.  Split the failure by WHAT the payload shows:
+        # a CPU-fallback payload is definitionally a tunnel flap
+        # (bench's own probe timed out) → None = retry at the next
+        # recovery, uncounted; a TPU-platform payload that still fails
+        # its check (value 0, bad arms) is a deterministic failure →
+        # False, which main() marks done for best-effort captures.
+        flap = _is_cpu_fallback(payload)
         print(f"[tpu_watch] {name}: rc={r.returncode} parsed=yes "
-              "ok=retry", flush=True)
-        return None
+              f"ok={'retry (cpu fallback)' if flap else 'bad payload'}",
+              flush=True)
+        return None if flap else False
     print(f"[tpu_watch] {name}: rc={r.returncode} "
           f"parsed={'yes' if payload else 'no'} ok={ok}", flush=True)
     return ok
+
+
+def _is_cpu_fallback(p: dict) -> bool:
+    """The payload shows the run fell back to the CPU mesh — i.e. the
+    tunnel flapped between the watcher's probe and the capture's own."""
+    if p.get("platform") == "cpu":
+        return True
+    arms = p.get("arms") or []
+    return any(a.get("platform") == "cpu" for a in arms)
 
 
 def _bench_on_tpu(p: dict) -> bool:
@@ -149,7 +161,6 @@ def main() -> int:
         max_hours = float(sys.argv[sys.argv.index("--max-hours") + 1])
     deadline = time.time() + max_hours * 3600
     done: set[str] = set()
-    check_fails: dict[str, int] = {}
     while time.time() < deadline:
         if probe():
             print("[tpu_watch] TPU healthy — capturing", flush=True)
@@ -169,23 +180,13 @@ def main() -> int:
                           flush=True)
                     break
                 elif res is False and not required:
-                    # Genuine (non-tunnel, non-check) failure of a
-                    # best-effort capture: record it done so it cannot
-                    # retry-loop forever ahead of the required studies.
+                    # Deterministic failure of a best-effort capture
+                    # (crash, or a TPU-platform payload failing its
+                    # check): record it done so it cannot retry-loop
+                    # forever ahead of the required studies.  res=None
+                    # (CPU-fallback payload = tunnel flap) stays un-done
+                    # and retries at the next recovery.
                     done.add(name)
-                elif res is None and not required:
-                    # Payload check failed (e.g. a CPU-fallback run):
-                    # retryable ONCE — a best-effort capture that fails
-                    # its check twice with a healthy tunnel is a
-                    # deterministic failure (an honest value-0 TPU run,
-                    # a repeatable compile error) and must not keep
-                    # burning its timeout ahead of the required studies.
-                    check_fails[name] = check_fails.get(name, 0) + 1
-                    if check_fails[name] >= 2:
-                        print(f"[tpu_watch] {name}: check failed "
-                              f"{check_fails[name]}x — giving up on it",
-                              flush=True)
-                        done.add(name)
             if {c[0] for c in CAPTURES if c[3]} <= done:
                 print("[tpu_watch] capture complete", flush=True)
                 return 0

@@ -255,10 +255,6 @@ class RingOracle:
                 return False
             return uu >= loss
 
-        def deliver(src: int, dst: int, extra: list[int]) -> None:
-            for sl in select_b(src) + extra:
-                st.knows[dst, sl] = True
-
         lha = st.lha.copy()
         if cfg.ring_probe == "rotor":
             # W1 + W2 (selection state mutates between waves, so evaluate

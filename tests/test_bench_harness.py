@@ -76,8 +76,10 @@ class TestWatcherCaptureChecks:
         assert not _ablation_on_tpu({"arms": []})
 
     def test_run_save_tristate(self, tmp_path, monkeypatch):
-        """rc=0 + parseable payload + failing check => None (retryable),
-        not False (permanent) and not True (done)."""
+        """The tri-state contract: CPU-fallback payload (tunnel flap) =>
+        None (retryable); TPU payload failing its check (deterministic
+        failure) => False (permanent for best-effort captures); passing
+        payload => True."""
         import scripts.tpu_watch as tw
 
         class _R:
@@ -92,6 +94,10 @@ class TestWatcherCaptureChecks:
         assert res is None
         # the artifact is still written (kept on disk for inspection)
         assert (tmp_path / "probe.json").exists()
+        # an honest TPU run that still fails the check is deterministic
+        _R.stdout = '{"platform": "default", "value": 0.0}\n'
+        assert tw.run_save("probe", ["x"], 5.0,
+                           check=tw._bench_on_tpu) is False
         # and a passing payload returns True
         _R.stdout = '{"platform": "default", "value": 9.0}\n'
         assert tw.run_save("probe", ["x"], 5.0,

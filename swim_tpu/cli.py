@@ -191,6 +191,16 @@ def _cmd_study(args: argparse.Namespace) -> int:
 
     kw = dict(n=args.nodes, periods=args.periods, seed=args.seed,
               engine=args.engine)
+    if args.sel_scope != "wave":
+        # the knob only exists on the ring engines — refuse to run (and
+        # then mislabel) a study whose resolved engine would ignore it
+        resolved = experiments.pick_engine(args.nodes, args.engine)
+        if not resolved.startswith("ring"):
+            print(f"error: --sel-scope {args.sel_scope} has no effect "
+                  f"on the '{resolved}' engine; pass --engine ring "
+                  "or ringshard", file=sys.stderr)
+            return 2
+        kw["ring_sel_scope"] = args.sel_scope   # flows into SwimConfig
     if args.study == "detection":
         kw["crash_fraction"] = args.crash_fraction
     elif args.study == "fp_sweep":
@@ -207,7 +217,12 @@ def _cmd_study(args: argparse.Namespace) -> int:
         kw["crash_fraction"] = args.crash_fraction
         kw["loss"] = args.loss
         kw["budget_arms"] = args.budget_arms
-    print(json.dumps(experiments.STUDIES[args.study](**kw)))
+    out = experiments.STUDIES[args.study](**kw)
+    if kw.get("ring_sel_scope"):
+        # self-describing results: a period-scope (deviation R5) study
+        # must never be quotable as an exact wave-scope one
+        out = {**out, "ring_sel_scope": kw["ring_sel_scope"]}
+    print(json.dumps(out))
     return 0
 
 
@@ -295,6 +310,10 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--mults", type=float, nargs="*",
                     default=[2.0, 3.0, 5.0, 8.0])
     st.add_argument("--no-partition", action="store_true")
+    st.add_argument("--sel-scope", choices=("wave", "period"),
+                    default="wave",
+                    help="ring piggyback-selection freshness (deviation "
+                         "R5; 'period' = the throughput mode)")
     st.add_argument("--budget-arms", action="store_true",
                     help="lifeguard study: add ring_orig_words=8 twin "
                          "arms (budget-vs-LHA attribution)")

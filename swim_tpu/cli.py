@@ -86,6 +86,20 @@ def _cmd_demo(args: argparse.Namespace) -> int:
         else 1
 
 
+def _reject_sel_scope(resolved_engine: str, sel_scope: str) -> bool:
+    """True (after printing the error) iff a non-wave --sel-scope was
+    passed for an engine that would silently ignore it.  The knob only
+    exists on the ring engines — refuse to run (and then mislabel) a run
+    whose resolved engine ignores it (ADVICE r3: `study` guarded,
+    `simulate` didn't; one shared guard for both)."""
+    if sel_scope != "wave" and not resolved_engine.startswith("ring"):
+        print(f"error: --sel-scope {sel_scope} has no effect on the "
+              f"'{resolved_engine}' engine; pass --engine ring or "
+              "ringshard", file=sys.stderr)
+        return True
+    return False
+
+
 def _cmd_simulate(args: argparse.Namespace) -> int:
     import time
 
@@ -99,6 +113,8 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
     from swim_tpu.sim import experiments, faults
 
     engine = experiments.pick_engine(args.nodes, args.engine)
+    if _reject_sel_scope(engine, args.sel_scope):
+        return 2
     cfg = SwimConfig(n_nodes=args.nodes, suspicion_mult=args.suspicion_mult,
                      lifeguard=args.lifeguard,
                      ring_sel_scope=args.sel_scope)
@@ -192,13 +208,8 @@ def _cmd_study(args: argparse.Namespace) -> int:
     kw = dict(n=args.nodes, periods=args.periods, seed=args.seed,
               engine=args.engine)
     if args.sel_scope != "wave":
-        # the knob only exists on the ring engines — refuse to run (and
-        # then mislabel) a study whose resolved engine would ignore it
         resolved = experiments.pick_engine(args.nodes, args.engine)
-        if not resolved.startswith("ring"):
-            print(f"error: --sel-scope {args.sel_scope} has no effect "
-                  f"on the '{resolved}' engine; pass --engine ring "
-                  "or ringshard", file=sys.stderr)
+        if _reject_sel_scope(resolved, args.sel_scope):
             return 2
         kw["ring_sel_scope"] = args.sel_scope   # flows into SwimConfig
     if args.study == "detection":

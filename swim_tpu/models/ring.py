@@ -394,7 +394,13 @@ def _col_select_multi(mat: jax.Array, cols: list[jax.Array]) -> list[jax.Array]:
     effective traffic per period at 1M nodes).  A single max-reduce of
     the one-hot-masked matrix instead reads `mat` exactly once, in its
     native tiling, per query.  Out-of-range c yields 0 (same as the
-    pre-clamped contract)."""
+    pre-clamped contract).
+
+    Contract: `mat` must hold UNSIGNED / NON-NEGATIVE values (u32
+    heard-words here) — the reduce is a max against a 0 fill, so a
+    negative selected value would be silently replaced by 0 (ADVICE
+    r3: the old OR-accumulate had the same restriction, made explicit
+    here)."""
     w_ids = jnp.arange(mat.shape[1], dtype=jnp.int32)
     zero = jnp.zeros((), mat.dtype)
     c = jnp.stack([jnp.asarray(x) for x in cols])            # [Q, N]
@@ -407,7 +413,9 @@ def _row_select_multi(mat: jax.Array, rows: list[jax.Array]) -> list[jax.Array]:
     """[mat[r[i], i] for r in rows] over a WORD-major [W, N] matrix —
     the `cold` twin of _col_select_multi (same one-hot-reduce shape;
     same rationale: a slice per word is a strided tile walk, a fused
-    masked reduce is one full-bandwidth pass per query)."""
+    masked reduce is one full-bandwidth pass per query).  Same
+    unsigned/non-negative-dtype contract: max-reduce against a 0 fill,
+    so negative values would be masked to 0."""
     w_ids = jnp.arange(mat.shape[0], dtype=jnp.int32)
     zero = jnp.zeros((), mat.dtype)
     r = jnp.stack([jnp.asarray(x) for x in rows])            # [Q, N]

@@ -194,14 +194,14 @@ def fig_suspicion_tradeoff():
 def fig_perf_sequence():
     """Round-3 optimization sequence: measured protocol-periods/sec at
     1M nodes on ONE TPU v5 lite chip after each profile-driven step
-    (docs/RESULTS.md §1; artifacts: bench_all.json round-3 capture,
-    flagship_tpu_r3.json).  Single series — magnitude over ordered
+    (docs/RESULTS.md §1; artifacts: bench_all_r2_cache_artifact.json
+    round-3 capture, flagship_tpu_r3.json).  Single series — magnitude over ordered
     stages — so: bars, one hue, direct value labels, no legend; the
     dotted line is the fused HBM roofline for the final (period-scope)
     geometry, the honest single-chip ceiling."""
     # The stage values are the round-3 HISTORICAL record — each number
     # is tied to a specific commit and preserved in
-    # bench_results/{bench_all,flagship_tpu_r3}.json; they are
+    # bench_results/{bench_all_r2_cache_artifact,flagship_tpu_r3}.json; they are
     # deliberately frozen here (a recapture updates the artifacts and
     # future-round tables, not this round's sequence).
     stages = [

@@ -473,7 +473,11 @@ def main() -> int:
     elif on_tpu:
         n_r = args.nodes or 1_000_000
         n_d = min(args.nodes or 4096, 8192)
-        periods = args.periods or 50
+        # 100 periods per dispatch: the axon tunnel charges ~66 ms per
+        # dispatch regardless of the work inside (RESULTS.md §1b #3), so
+        # longer scans amortize it — at the round-3 52 p/s this halves
+        # the per-period dispatch tax from ~1.3 ms to ~0.7 ms.
+        periods = args.periods or 100
     else:
         n_r = args.nodes or 65_536
         n_d = min(args.nodes or 1024, 2048)

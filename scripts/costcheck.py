@@ -1,0 +1,104 @@
+"""Compile-time cost proxy for the ring step (no TPU required).
+
+Prints, for one jitted ring period at the given N (CPU backend):
+  * XLA cost-analysis bytes accessed (the HBM-traffic proxy that drove
+    the round-3 strided-tile-walk discovery: 119 -> 9.7 GB/period),
+  * optimized-HLO kernel counts (fusion/convert/etc. — a launch-overhead
+    proxy: the measured TPU tail at 1M is dominated by many small
+    [N]-vector kernels, so fewer kernels is directionally better),
+  * wall-clock per period on this host (weak proxy, reported for trend).
+
+Usage: python scripts/costcheck.py [N] [--sel-scope period] [--probe rotor]
+       [--periods 3] [--unroll 1]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", type=int, nargs="?", default=262_144)
+    ap.add_argument("--sel-scope", default="period",
+                    choices=("wave", "period"))
+    ap.add_argument("--probe", default="rotor", choices=("rotor", "pull"))
+    ap.add_argument("--periods", type=int, default=3)
+    ap.add_argument("--unroll", type=int, default=1)
+    ap.add_argument("--no-run", action="store_true",
+                    help="analysis only (skip the timed execution)")
+    args = ap.parse_args()
+
+    from swim_tpu.utils.platform import force_cpu
+    force_cpu(1)
+
+    import jax
+    import jax.numpy as jnp
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import ring
+    from swim_tpu.sim import faults
+
+    cfg = SwimConfig(n_nodes=args.n, ring_sel_scope=args.sel_scope,
+                     ring_probe=args.probe)
+    state = ring.init_state(cfg)
+    plan = faults.with_random_crashes(
+        faults.none(args.n), jax.random.key(1), 0.001, 0, args.periods)
+    key = jax.random.key(0)
+
+    def one(st, seed):
+        def body(s, _):
+            rnd = ring.draw_period_ring(
+                jax.random.fold_in(key, seed), s.step, cfg)
+            return ring.step(cfg, s, plan, rnd), None
+        s, _ = jax.lax.scan(body, st, None, length=args.periods,
+                            unroll=args.unroll)
+        return s
+
+    t0 = time.perf_counter()
+    lowered = jax.jit(one).lower(state, jnp.int32(0))
+    compiled = lowered.compile()
+    t_compile = time.perf_counter() - t0
+
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    bytes_total = float(ca.get("bytes accessed", 0.0))
+    per_period = bytes_total / args.periods
+    print(f"N={args.n} scope={args.sel_scope} probe={args.probe} "
+          f"periods={args.periods} unroll={args.unroll}")
+    print(f"compile: {t_compile:.1f}s")
+    print(f"cost-analysis bytes: {bytes_total/1e9:.3f} GB total, "
+          f"{per_period/1e9:.3f} GB/period")
+    # flops for completeness (the step is bandwidth-bound; flops tiny)
+    print(f"cost-analysis flops: {float(ca.get('flops', 0.0))/1e9:.3f} G")
+
+    hlo = compiled.as_text()
+    kinds = collections.Counter()
+    for m in re.finditer(r"^\s*(?:ROOT\s+)?%?[\w.-]+\s*=\s*[\w\[\]{},<> ]*?"
+                         r"\b(fusion|custom-call|while|sort|scatter|gather|"
+                         r"reduce|convolution|dot)\b", hlo, re.M):
+        kinds[m.group(1)] += 1
+    print("optimized-HLO op counts:", dict(kinds))
+
+    if not args.no_run:
+        out = compiled(state, jnp.int32(0))
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = compiled(state, jnp.int32(1))
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        print(f"cpu wall: {dt/args.periods*1e3:.1f} ms/period "
+              f"({args.periods/dt:.2f} periods/sec)")
+        assert int(out.step) == args.periods
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

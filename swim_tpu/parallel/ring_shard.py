@@ -84,7 +84,10 @@ class ShardOps:
     constructed INSIDE the shard_map-traced function (uses axis_index).
     """
 
-    supports_random_gather = False
+    supports_random_gather = True   # via the nodewise ring-pass
+    #                                 exchanges below (round 4) — the
+    #                                 fidelity pull mode, not the
+    #                                 throughput path
 
     def __init__(self, cfg: SwimConfig, n_shards: int):
         self.n = cfg.n_nodes
@@ -172,6 +175,68 @@ class ShardOps:
         return jax.lax.psum(
             jnp.where(owned, v, jnp.zeros((), v.dtype)), AXIS)
 
+    # -- nodewise exchanges (sharded pull mode; round 4) ------------------
+    #
+    # The psum-style gather/knows_words above require REPLICATED query
+    # arrays (each shard must pose the same queries, or the elementwise
+    # psum would mix different shards' questions).  The pull branch's
+    # queries are NODE-AXIS — each shard asks about ITS rows' randomly
+    # sampled peers — so they route through a D-step ppermute ring
+    # pass instead: the query bundle visits every shard once, each
+    # shard answers the entries it owns (local gathers), and after D
+    # hops the bundle is home with exact answers.  This IS the
+    # all-to-all the scatter-free rotor path avoids (RESULTS.md §2):
+    # D ppermute rounds of [S]-sized payloads plus O(N) local gather
+    # rows per exchange per period — correct and bitwise-equal to the
+    # single-program engine, deliberately not the throughput path.
+
+    def _shift1(self, x):
+        if self.d == 1:
+            return x
+        perm = [(p, (p + 1) % self.d) for p in range(self.d)]
+        return jax.lax.ppermute(x, AXIS, perm)
+
+    def gather_nodewise(self, arr, idx):
+        """arr[idx] for node-axis arr and node-axis GLOBAL ids [S]."""
+        qids, acc = idx, jnp.zeros((self.s,) + arr.shape[1:], arr.dtype)
+        for _ in range(self.d):
+            owned = (qids >= self.lo) & (qids < self.lo + self.s)
+            lr = jnp.clip(qids - self.lo, 0, self.s - 1)
+            v = arr[lr]
+            ow = owned.reshape((-1,) + (1,) * (arr.ndim - 1))
+            acc = jnp.where(ow, v, acc)
+            qids, acc = self._shift1(qids), self._shift1(acc)
+        return acc
+
+    def gather_rows(self, mat, idx):
+        return self.gather_nodewise(mat, idx)
+
+    def knows_nodewise(self, win, cold, slot_pos, rows, slot):
+        """Heard-bit of global node ids `rows` [S] for ring slots
+        `slot` [S] — the nodewise twin of knows_words.  The queried
+        WORD travels the ring; the bit index stays home (slot_pos is
+        pure replicated geometry, so computing it query-side is exact)."""
+        ok, wcol, word_r, bit = slot_pos(slot)
+        q, f, c, r = rows, ok, wcol, word_r
+        acc = jnp.zeros((self.s,), win.dtype)
+        for _ in range(self.d):
+            owned = (q >= self.lo) & (q < self.lo + self.s)
+            lr = jnp.clip(q - self.lo, 0, self.s - 1)
+            word = jnp.where(f, win[lr, c], cold[r, lr])
+            acc = jnp.where(owned, word, acc)
+            q, f, c, r, acc = (self._shift1(q), self._shift1(f),
+                               self._shift1(c), self._shift1(r),
+                               self._shift1(acc))
+        return (slot >= 0) & (((acc >> bit) & 1) > 0)
+
+    def knows_self(self, win, cold, slot_pos, slot):
+        """Heard-bit of each LOCAL row for ring slots `slot` [S] — no
+        exchange (every query is owned here)."""
+        ok, wcol, word_r, bit = slot_pos(slot)
+        lr = jnp.arange(self.s, dtype=jnp.int32)
+        word = jnp.where(ok, win[lr, wcol], cold[word_r, lr])
+        return (slot >= 0) & (((word >> bit) & 1) > 0)
+
     def knows_words(self, win, cold, slot_pos, rows, slot):
         # cold is word-major: [RW, local N]
         ok, wcol, word_r, bit = slot_pos(slot)
@@ -183,10 +248,14 @@ class ShardOps:
             jnp.where(owned, kn, False).astype(jnp.int32), AXIS) > 0
 
     def first_true_nodes(self, valid, k):
-        gk = jnp.where(valid, self.n - self.ids(), 0)
+        # per-shard sort-free compaction (ring._first_true_idx), then a
+        # small all-gather + merge of the D candidate lists — the merge
+        # keys are n - id so one descending top_k yields ascending ids
         kl = min(k, self.s)
-        kk = ring._top_k_vals(gk, kl)
-        merged = jax.lax.all_gather(kk, AXIS).reshape(-1)   # [D * kl]
+        lidx = ring._first_true_idx(valid, kl)              # local rows
+        gidx = jnp.where(lidx < self.s, lidx + self.lo, self.n)
+        gk = jnp.where(gidx < self.n, self.n - gidx, 0)
+        merged = jax.lax.all_gather(gk, AXIS).reshape(-1)   # [D * kl]
         kk2, _ = jax.lax.top_k(merged, min(k, self.d * kl))
         idx = jnp.where(kk2 > 0, self.n - kk2, self.n)
         if k > idx.shape[0]:
@@ -215,9 +284,18 @@ def _plan_specs() -> FaultPlan:
 
 
 def _rnd_specs(cfg: SwimConfig) -> ring.RingRandomness:
-    if cfg.ring_probe != "rotor":
-        raise NotImplementedError(
-            "sharded ring engine supports rotor probing only")
+    if cfg.ring_probe == "pull":
+        # pull mode: the loss_w*/lha_u fields are empty (0,) arrays —
+        # replicated; every pull uniform is per-node — sharded
+        return ring.RingRandomness(
+            s_off=P(), q_off=P(), loss_w1=P(), loss_w2=P(),
+            loss_w3=P(), loss_w4=P(), loss_w5=P(), loss_w6=P(),
+            lha_u=P(),
+            pull=ring.PullRandomness(
+                m_u=P(AXIS), src_u=P(AXIS, None), d_fwd=P(AXIS),
+                d_back=P(AXIS), px_u=P(AXIS, None),
+                px_fwd=P(AXIS, None), px_back=P(AXIS, None),
+                ack_u=P(AXIS), ack_leg=P(AXIS)))
     return ring.RingRandomness(
         s_off=P(), q_off=P(), loss_w1=P(AXIS), loss_w2=P(AXIS),
         loss_w3=P(AXIS, None), loss_w4=P(AXIS, None),

@@ -246,3 +246,61 @@ class TestTopKVals:
         a = np.asarray(_top_k_vals(jnp.asarray(x), 4096))
         b = np.asarray(jax.lax.top_k(jnp.asarray(x), 4096)[0])
         np.testing.assert_array_equal(a, b)
+
+
+class TestFirstTrueIdx:
+    """ring._first_true_idx is the sort-free compaction behind both
+    layouts' first_true_nodes (round 4).  Its contract is exact: the
+    ascending indices of the first k True entries, n-filled — one
+    dropped or reordered index would silently reorder originations, so
+    it is pinned element-for-element against the trivial numpy spec."""
+
+    def _spec(self, valid, k):
+        import numpy as np
+
+        n = valid.shape[0]
+        idx = np.flatnonzero(valid)[:k]
+        return np.concatenate(
+            [idx, np.full(k - idx.size, n)]).astype(np.int32)
+
+    def test_matches_spec(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu.models.ring import _first_true_idx
+
+        rng = np.random.default_rng(11)
+        for n in (5, 1000, 1024, 4096, 100_000, 1_000_001):
+            for k in (1, 64, 300):
+                for density in (0.0, 0.0005, 0.02, 1.0):
+                    valid = rng.random(n) < density
+                    a = np.asarray(_first_true_idx(jnp.asarray(valid), k))
+                    np.testing.assert_array_equal(
+                        a, self._spec(valid, k),
+                        err_msg=f"n={n} k={k} density={density}")
+
+    def test_k_exceeds_n(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu.models.ring import _first_true_idx
+
+        valid = np.array([False, True, True])
+        a = np.asarray(_first_true_idx(jnp.asarray(valid), 8))
+        np.testing.assert_array_equal(a, self._spec(valid, 8))
+
+    def test_clustered_and_trailing(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu.models.ring import _first_true_idx
+
+        # all trues in one late block; empty blocks before it share its
+        # cumulative offset — the searchsorted tie-break must still land
+        # on the non-empty block
+        n = 10_000
+        valid = np.zeros(n, bool)
+        valid[8192:8200] = True
+        valid[n - 1] = True
+        a = np.asarray(_first_true_idx(jnp.asarray(valid), 16))
+        np.testing.assert_array_equal(a, self._spec(valid, 16))

@@ -4,10 +4,12 @@
 "Host bridge"; VERDICT r2 "Missing #3"): where `bridge/server.py` hosts
 an event-driven cluster of real `core/node.py` nodes, this server hosts
 an N-node RING-ENGINE simulation (swim_tpu/models/ring.py) and couples
-ONE externally-driven node id to it over the existing lockstep TCP
-protocol (bridge/protocol.py) — so an untouched foreign SWIM core (e.g.
-swim_tpu/native/bridge_client.cpp) probes, gossips with, and detects
-failures among tens of thousands of tensor-simulated peers.
+K externally-driven node ids to it over the existing lockstep TCP
+protocol (bridge/protocol.py) — so untouched foreign SWIM cores (e.g.
+swim_tpu/native/bridge_client.cpp) probe, gossip with, and detect
+failures among tens of thousands of tensor-simulated peers AND each
+other (multi-session lockstep barrier + hub-routed core↔core
+datagrams — see the class docstring; round 4, VERDICT r3 item 5).
 
 The seam, per protocol period (one `STEP` accumulation of cfg.protocol_period):
 
@@ -89,13 +91,46 @@ def _pack_key(status: Status, inc: int) -> int:
     return opinion_key(int(status), inc)
 
 
-class EngineBridgeServer:
-    """Single-client lockstep server over a ring-engine simulation."""
+class _Session:
+    """One TCP connection hosting one or more external node ids."""
 
-    def __init__(self, cfg: SwimConfig, external_id: int, seed: int = 0,
-                 host: str = "127.0.0.1", port: int = 0,
+    def __init__(self, sock: socket.socket):
+        import time
+
+        self.sock = sock
+        self.ids: list[int] = []
+        self.clock = 0.0                 # this session's virtual time
+        self.outq: list[bp.Frame] = []
+        self.live = True
+        self.last_step_wall = time.monotonic()
+
+
+class EngineBridgeServer:
+    """Multi-client lockstep server over a ring-engine simulation.
+
+    K external cores (each its own TCP session; a session may HELLO
+    several ids, like ExternalNodeHost) co-simulate against one tensor
+    cluster.  Time is conservative lockstep across sessions: each STEP
+    advances only that session's virtual clock, and an engine period
+    runs when EVERY live joined session has reached the period boundary
+    (the barrier is min over session clocks — with one session this
+    degenerates to the original single-client behavior exactly).  A
+    session that disconnects leaves the barrier; its rows then miss
+    their mirrored-probe acks and are crash-gated after `ack_grace`
+    periods, so the remaining cores detect the departure organically.
+
+    Datagrams between two external ids short-circuit over the wire
+    (one D4 loss draw, no tensor involvement): the server is the hub,
+    and two foreign cores can probe and gossip with EACH OTHER while
+    both remain coupled to the tensor cluster.
+    """
+
+    def __init__(self, cfg: SwimConfig, external_id: int | None = None,
+                 seed: int = 0, host: str = "127.0.0.1", port: int = 0,
                  ext_capacity: int = 16, ack_grace: int = 3,
-                 join_sample: int = 128):
+                 join_sample: int = 128,
+                 external_ids: list[int] | None = None,
+                 stall_timeout: float = 60.0):
         import jax
 
         from swim_tpu.models import ring
@@ -103,20 +138,32 @@ class EngineBridgeServer:
         if cfg.ring_probe != "rotor":
             raise ValueError("EngineBridgeServer requires the rotor probe "
                              "(the mirrored-ping seam is rotor-shaped)")
+        if external_ids is None:
+            if external_id is None:
+                raise ValueError("pass external_id or external_ids")
+            external_ids = [external_id]
+        elif external_id is not None:
+            raise ValueError("pass external_id OR external_ids, not both")
         self.cfg = cfg
         self.n = cfg.n_nodes
-        if not 0 <= external_id < self.n:
-            raise ValueError("external_id must be one of the N node ids")
-        self.x = external_id
+        for x in external_ids:
+            if not 0 <= x < self.n:
+                raise ValueError("external ids must be N node ids")
+        if len(set(external_ids)) != len(external_ids):
+            raise ValueError("duplicate external ids")
+        self.xs = list(external_ids)
+        self.x = self.xs[0]              # back-compat accessor
         self.ext_capacity = ext_capacity
         self.ack_grace = ack_grace
         self.join_sample = join_sample
+        self.stall_timeout = stall_timeout   # wall s without a STEP
+        #                                      before a session stops
+        #                                      gating the barrier
         self._jax = jax
         self._ring = ring
         self._key = jax.random.key(seed)
         self.state = ring.init_state(cfg)
         self.t = 0                       # completed protocol periods
-        self._frac = 0.0                 # virtual time into the period
         # host-side fault mirrors (device plan rebuilt on change)
         self._crash = np.full((self.n,), np.iinfo(np.int32).max // 2,
                               np.int32)
@@ -132,19 +179,33 @@ class EngineBridgeServer:
         self._subject = np.asarray(self.state.subject)
         self._rkey = np.asarray(self.state.rkey)
         self._gone = np.asarray(self.state.gone_key)
-        self._prev_row = self._resolved_row(self.x)
-        self._last_ack = -1              # newest mirrored-ping period acked
-        self._joined = False
-        self._x_crashed = False
-        self._outq: list[bp.Frame] = []
-        self._lock = threading.Lock()    # guards _outq/_inject/_crash
+        # per-external-id seam state
+        self._prev_rows: dict[int, np.ndarray] = {}
+        self._last_acks: dict[int, int] = {}
+        self._ext_crashed: dict[int, bool] = {x: False for x in self.xs}
+        self._owner: dict[int, _Session] = {}    # joined id -> session
+        self._claimed: set[int] = set()          # ids ever HELLO'd
+        self._sessions: list[_Session] = []
+        self._lock = threading.Lock()    # guards queues/_inject/_crash
         #                                  (test hooks run off-thread)
+        self._engine = threading.Lock()  # serializes period execution
+        self._closing = False
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
-        self._sock.listen(1)
+        self._sock.listen(max(len(self.xs), 1))
         self.address = self._sock.getsockname()
         self._thread: threading.Thread | None = None
+
+    # -------------------------------------------------- back-compat views
+
+    @property
+    def _joined(self) -> bool:
+        return bool(self._owner)
+
+    @property
+    def _x_crashed(self) -> bool:
+        return self._ext_crashed[self.x]
 
     # ------------------------------------------------------------ lifecycle
 
@@ -156,48 +217,140 @@ class EngineBridgeServer:
         if self._thread is not None:
             self._thread.join(timeout)
 
-    def _serve(self) -> None:
+    def close(self) -> None:
+        self._closing = True
         try:
-            conn, _ = self._sock.accept()
+            self._sock.close()
         except OSError:
-            return
+            pass
+        # unblock handler threads parked in read_frame: close every
+        # session socket too (a reader then sees EOF/OSError and exits)
+        with self._lock:
+            sessions = list(self._sessions)
+        for sess in sessions:
+            try:
+                sess.sock.close()
+            except OSError:
+                pass
+
+    def _serve(self) -> None:
+        """Accept loop: one handler thread per session.  Exits (closing
+        the listen socket) once every external id has been claimed and
+        all sessions have disconnected — or on close()."""
+        self._sock.settimeout(0.25)
+        handlers: list[threading.Thread] = []
+        try:
+            while not self._closing:
+                with self._lock:
+                    done = (len(self._claimed) == len(self.xs)
+                            and not any(s.live for s in self._sessions)
+                            and self._claimed)
+                if done:
+                    return
+                try:
+                    conn, _ = self._sock.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+                sess = _Session(conn)
+                with self._lock:
+                    self._sessions.append(sess)
+                th = threading.Thread(target=self._serve_session,
+                                      args=(sess,), daemon=True)
+                th.start()
+                handlers.append(th)
+        finally:
+            for th in handlers:
+                th.join(timeout=10)
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _serve_session(self, sess: _Session) -> None:
         try:
             while True:
-                f = bp.read_frame(conn)
+                f = bp.read_frame(sess.sock)
                 if f is None or f.op == bp.BYE:
                     return
-                self._handle(conn, f)
+                self._handle(sess, f)
         except (ValueError, OSError):
             return
         finally:
-            conn.close()
-            self._sock.close()
+            with self._lock:
+                sess.live = False
+            sess.sock.close()
 
     # ------------------------------------------------------------- protocol
 
-    def _now(self) -> float:
-        return self.t * self.cfg.protocol_period + self._frac
+    def _gating_clocks(self) -> list[float]:
+        """Virtual clocks of the sessions that gate the barrier: live,
+        joined, and not wall-clock-stalled.  A session that keeps its
+        socket open but stops STEPping (hung process) would otherwise
+        freeze engine time forever AND dodge the ack_grace crash-gate
+        (which only runs inside _run_period) — after `stall_timeout`
+        wall seconds without a STEP it stops gating; its rows then miss
+        their mirrored-probe acks and die organically.  Caller holds
+        self._lock."""
+        import time
 
-    def _handle(self, conn: socket.socket, f: bp.Frame) -> None:
+        now = time.monotonic()
+        return [s.clock for s in self._sessions
+                if s.live and s.ids
+                and now - s.last_step_wall <= self.stall_timeout]
+
+    def _handle(self, sess: _Session, f: bp.Frame) -> None:
         if f.op == bp.HELLO:
-            if f.a != self.x or self._joined:
-                bp.write_frame(conn, bp.Frame(bp.ERROR, a=bp.ERR_ID_TAKEN))
-                return
-            self._joined = True
-            self._last_ack = self.t  # grace starts at join
-            bp.write_frame(conn, bp.Frame(bp.WELCOME, a=f.a, t=self._now()))
-        elif f.op == bp.SEND:
-            self._on_datagram(f.a, f.b, f.payload)
-        elif f.op == bp.STEP:
-            self._frac += f.t
-            while self._frac >= self.cfg.protocol_period - 1e-9:
-                self._frac -= self.cfg.protocol_period
-                self._run_period()
             with self._lock:
-                flush, self._outq = self._outq, []
+                ok = f.a in self.xs and f.a not in self._claimed
+                if ok:
+                    self._claimed.add(f.a)
+                    self._owner[f.a] = sess
+                    sess.ids.append(f.a)
+                    self._last_acks[f.a] = self.t
+                    # join pins this session's clock at engine time
+                    sess.clock = max(
+                        sess.clock, self.t * self.cfg.protocol_period)
+            if not ok:
+                bp.write_frame(sess.sock,
+                               bp.Frame(bp.ERROR, a=bp.ERR_ID_TAKEN))
+                return
+            with self._engine:
+                # serialized vs _run_period: the row extraction reads
+                # self.state, which a concurrent STEP would be replacing
+                self._prev_rows[f.a] = self._resolved_row(f.a)
+            bp.write_frame(sess.sock,
+                           bp.Frame(bp.WELCOME, a=f.a, t=sess.clock))
+        elif f.op == bp.SEND:
+            if f.a in sess.ids:
+                with self._engine:
+                    # serialized vs _run_period: the seam reads self.t,
+                    # self.state, and the table mirrors, which a period
+                    # running on another session's thread updates
+                    # non-atomically
+                    self._on_datagram(f.a, f.b, f.payload)
+        elif f.op == bp.STEP:
+            import time
+
+            with self._engine:
+                with self._lock:
+                    sess.clock += f.t
+                    sess.last_step_wall = time.monotonic()
+                # conservative barrier: run whole periods while EVERY
+                # gating session has crossed the next boundary
+                while True:
+                    boundary = (self.t + 1) * self.cfg.protocol_period
+                    with self._lock:
+                        gating = self._gating_clocks()
+                    if not gating or min(gating) < boundary - 1e-9:
+                        break
+                    self._run_period()
+                with self._lock:
+                    flush, sess.outq = sess.outq, []
             for fr in flush:
-                bp.write_frame(conn, fr)
-            bp.write_frame(conn, bp.Frame(bp.TIME, t=self._now()))
+                bp.write_frame(sess.sock, fr)
+            bp.write_frame(sess.sock, bp.Frame(bp.TIME, t=sess.clock))
         elif f.op == bp.KILL:
             self.kill(f.a)
         elif f.op == bp.SET_LOSS:
@@ -254,7 +407,20 @@ class EngineBridgeServer:
         return self._loss > 0.0 and self._rng.random() < self._loss
 
     def _on_datagram(self, src: int, dst: int, payload: bytes) -> None:
-        if src != self.x:
+        """One datagram from external node `src` (session-verified by
+        the caller).  A dst owned by another LIVE session short-circuits
+        over the wire — the hub path that lets two foreign cores talk to
+        each other directly — after one D4 loss draw; everything else is
+        the engine seam."""
+        with self._lock:
+            owner = self._owner.get(dst)
+            owner_live = owner is not None and owner.live
+        if owner_live and dst != src:
+            if self._lost():
+                return
+            with self._lock:
+                owner.outq.append(bp.Frame(bp.DELIVER, a=src, b=dst,
+                                           payload=payload))
             return
         try:
             msg = codec.decode(payload)
@@ -264,14 +430,18 @@ class EngineBridgeServer:
             return     # datagram to a dead node, or lost on the wire:
             #            nothing is heard and nothing replies (D4)
         self._queue_injections(dst, msg.gossip)
-        if msg.kind == MsgKind.PING:
+        if msg.kind == MsgKind.ACK:
+            # the core answered a mirrored ping: liveness credit for
+            # the sending external id
+            self._last_acks[src] = self.t
+        elif msg.kind == MsgKind.PING:
             if self._lost():             # ack leg draws its own loss
                 return
             ack = codec.Message(kind=MsgKind.ACK, sender=dst,
                                 probe_seq=msg.probe_seq,
                                 on_behalf=msg.on_behalf,
                                 gossip=self._transmissible(dst))
-            self._deliver(dst, ack)
+            self._deliver(src, dst, ack)
         elif msg.kind == MsgKind.PING_REQ:
             tgt = msg.target
             # proxy round-trip: two more legs (proxy->tgt, tgt->proxy)
@@ -282,19 +452,21 @@ class EngineBridgeServer:
                                     probe_seq=msg.probe_seq,
                                     on_behalf=tgt,
                                     gossip=self._transmissible(tgt))
-                self._deliver(dst, ack)
-        elif msg.kind == MsgKind.ACK:
-            self._last_ack = self.t      # the core answered a mirrored ping
+                self._deliver(src, dst, ack)
         elif msg.kind == MsgKind.JOIN:
             if self._lost():             # reply leg draws loss too (D4)
                 return
-            self._deliver(dst, codec.Message(
+            self._deliver(src, dst, codec.Message(
                 kind=MsgKind.JOIN_REPLY, sender=dst,
-                gossip=self._join_snapshot()))
+                gossip=self._join_snapshot(exclude=src)))
 
-    def _deliver(self, sender: int, msg: codec.Message) -> None:
+    def _deliver(self, x: int, sender: int, msg: codec.Message) -> None:
+        """Queue a DELIVER to external id x's owning session."""
         with self._lock:
-            self._outq.append(bp.Frame(bp.DELIVER, a=sender, b=self.x,
+            owner = self._owner.get(x)
+            if owner is None or not owner.live:
+                return
+            owner.outq.append(bp.Frame(bp.DELIVER, a=sender, b=x,
                                        payload=codec.encode(msg)))
 
     # -------------------------------------------------------- outbound seam
@@ -304,11 +476,12 @@ class EngineBridgeServer:
 
         from swim_tpu.models import ring
 
-        # liveness gate: a silent core is a crashed member
-        if (self._joined and not self._x_crashed
-                and self.t - self._last_ack > self.ack_grace):
-            self.kill(self.x)
-            self._x_crashed = True
+        # liveness gate: a silent core is a crashed member (per id)
+        for x in list(self._prev_rows):
+            if (not self._ext_crashed[x]
+                    and self.t - self._last_acks[x] > self.ack_grace):
+                self.kill(x)
+                self._ext_crashed[x] = True
         ext = ring.ext_none(self.ext_capacity)
         with self._lock:
             batch, self._inject = (self._inject[:self.ext_capacity],
@@ -334,24 +507,24 @@ class EngineBridgeServer:
                                 ext=ext)
         s_off = int(jax.device_get(rnd.s_off))
         self.t += 1
-        # refresh table mirrors, then mirror the rotor probe of X
+        # refresh table mirrors, then mirror the rotor probe of every
+        # joined external id
         self._subject = np.asarray(self.state.subject)
         self._rkey = np.asarray(self.state.rkey)
         self._gone = np.asarray(self.state.gone_key)
-        row = self._resolved_row(self.x)
-        fresh = row & ~self._prev_row
-        self._prev_row = row
-        if not self._joined:
-            return
-        prober = (self.x - s_off) % self.n
-        if not self._alive(prober):
-            return                       # no probe of X this period
-        updates = self._slots_to_updates(np.nonzero(fresh)[0], prober)
-        for chunk in range(0, max(len(updates), 1), 255):
-            ping = codec.Message(kind=MsgKind.PING, sender=prober,
-                                 probe_seq=self.t,
-                                 gossip=tuple(updates[chunk:chunk + 255]))
-            self._deliver(prober, ping)
+        for x in list(self._prev_rows):
+            row = self._resolved_row(x)
+            fresh = row & ~self._prev_rows[x]
+            self._prev_rows[x] = row
+            prober = (x - s_off) % self.n
+            if not self._alive(prober):
+                continue                 # no probe of x this period
+            updates = self._slots_to_updates(np.nonzero(fresh)[0], prober)
+            for chunk in range(0, max(len(updates), 1), 255):
+                ping = codec.Message(
+                    kind=MsgKind.PING, sender=prober, probe_seq=self.t,
+                    gossip=tuple(updates[chunk:chunk + 255]))
+                self._deliver(x, prober, ping)
 
     # ------------------------------------------------------- state decoding
 
@@ -423,14 +596,17 @@ class EngineBridgeServer:
                 break
         return tuple(out)
 
-    def _join_snapshot(self) -> tuple[codec.WireUpdate, ...]:
+    def _join_snapshot(self, exclude: int) -> tuple[codec.WireUpdate, ...]:
         """Up to `join_sample` alive members, spread across the id space
         (the wire gossip count is u8 — a 64k snapshot cannot fit, and
-        SWIM only needs a partial view to bootstrap probing)."""
+        SWIM only needs a partial view to bootstrap probing).  `exclude`
+        is the REQUESTING joiner (a node must not bootstrap itself);
+        other external ids stay includable — they are legitimate,
+        probeable members."""
         stride = max(1, self.n // self.join_sample)
         out = []
         for m in range(0, self.n, stride):
-            if m != self.x and self._alive(m):
+            if m != exclude and self._alive(m):
                 out.append(codec.WireUpdate(
                     member=m, status=Status.ALIVE, incarnation=0,
                     addr=("sim", m), origin=m))
@@ -448,14 +624,16 @@ class EngineBridgeServer:
                 (subject, _pack_key(status, inc), origin, hearer))
 
     def deliver_forged(self, sender: int,
-                       updates: list[codec.WireUpdate]) -> None:
-        """DELIVER a forged gossip-bearing ping to the core WITHOUT
-        touching tensor state.  Test use: forge suspect(X) on the wire
-        only — the engine's shadow row never sees a suspicion, so any
-        alive(X, inc≥1) that later appears in tensor state can ONLY be
-        the foreign core's refutation arriving through the injection
-        seam (the engine-side proof is inc_self[X] staying 0)."""
-        self._deliver(sender, codec.Message(
+                       updates: list[codec.WireUpdate],
+                       to: int | None = None) -> None:
+        """DELIVER a forged gossip-bearing ping to a core WITHOUT
+        touching tensor state (default target: the first external id).
+        Test use: forge suspect(X) on the wire only — the engine's
+        shadow row never sees a suspicion, so any alive(X, inc≥1) that
+        later appears in tensor state can ONLY be the foreign core's
+        refutation arriving through the injection seam (the engine-side
+        proof is inc_self[X] staying 0)."""
+        self._deliver(self.x if to is None else to, sender, codec.Message(
             kind=MsgKind.PING, sender=sender, probe_seq=0,
             gossip=tuple(updates)))
 

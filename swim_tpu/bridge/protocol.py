@@ -1,5 +1,8 @@
 """Bridge wire protocol: length-prefixed binary frames over TCP.
 
+Normative spec + conformance checklist: docs/BRIDGE.md (this module is
+the executable form of its §1 frame table).
+
 This is the contract for an EXTERNAL protocol core (the reference's Haskell
 `Swim.Protocol` behind a `Swim.Transport` instance — SURVEY.md §2 "Host
 bridge") to participate in a swim_tpu simulated cluster. The format is

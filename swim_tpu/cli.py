@@ -212,6 +212,14 @@ def _cmd_study(args: argparse.Namespace) -> int:
         if _reject_sel_scope(resolved, args.sel_scope):
             return 2
         kw["ring_sel_scope"] = args.sel_scope   # flows into SwimConfig
+    if args.probe:
+        resolved = experiments.pick_engine(args.nodes, args.engine)
+        if not resolved.startswith("ring"):
+            print(f"error: --probe {args.probe} has no effect on the "
+                  f"'{resolved}' engine; pass --engine ring or "
+                  "ringshard", file=sys.stderr)
+            return 2
+        kw["ring_probe"] = args.probe   # flows into SwimConfig
     if args.study == "detection":
         kw["crash_fraction"] = args.crash_fraction
     elif args.study == "fp_sweep":
@@ -328,6 +336,14 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--budget-arms", action="store_true",
                     help="lifeguard study: add ring_orig_words=8 twin "
                          "arms (budget-vs-LHA attribution)")
+    st.add_argument("--probe", choices=("rotor", "pull"), default=None,
+                    help="ring probe pattern override. The detection "
+                         "study defaults the single-program ring engine "
+                         "to 'pull' (law-preserving uniform probing — "
+                         "the paper's e/(e-1) regime); pass 'rotor' to "
+                         "opt into the bounded-detection throughput "
+                         "mode (deviation R1). Other studies and the "
+                         "sharded layout default to rotor.")
     st.set_defaults(fn=_cmd_study)
 
     br = sub.add_parser(

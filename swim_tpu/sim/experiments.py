@@ -96,6 +96,18 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
                     engine: str = "auto", **cfg_kw) -> dict[str, Any]:
     """Config 2: crash-stop injection → detection-time distribution."""
     engine = pick_engine(n, engine)
+    if engine in ("ring", "ringshard"):
+        # Fidelity by default (round 4; VERDICT r3 item 8): this study
+        # exists to measure the paper's e/(e-1) first-detection law,
+        # and the flagship rotor probe is by construction in the
+        # deterministic-bound regime instead (detects in <= ~2 periods
+        # — deviation R1).  Both ring layouts therefore default to the
+        # law-preserving pull-uniform probe HERE (the sharded layout
+        # routes pull's random-peer reads through nodewise ring-pass
+        # exchanges — correct, deliberately not the throughput path);
+        # rotor stays the explicit throughput opt-in
+        # (ring_probe="rotor") and remains the default everywhere else.
+        cfg_kw.setdefault("ring_probe", "pull")
     cfg = SwimConfig(n_nodes=n, **cfg_kw)
     plan = faults.with_random_crashes(
         faults.none(n), jax.random.key(seed + 1), crash_fraction,
@@ -104,6 +116,9 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
     out = {"study": "detection", "n": n, "periods": periods,
            "engine": engine, "crash_fraction": crash_fraction,
            "suspicion_periods": cfg.suspicion_periods}
+    if engine in ("ring", "ringshard"):
+        # self-describing: which probe regime produced these latencies
+        out["ring_probe"] = cfg.ring_probe
     out.update(runner.detection_summary(res, plan, periods))
     out.update(metrics.series_digest(res.series))
     if engine in ("rumor", "shard", "ring", "ringshard"):

@@ -129,6 +129,43 @@ class TestDetectionLatencyLaw:
             f"critical {crit:.4f} at alpha=0.01 (m={len(lats)})")
 
 
+class TestFidelityByDefault:
+    """The DETECTION study must default the single-program ring engine
+    to the law-preserving pull probe (round 4; VERDICT r3 item 8):
+    rotor's deterministic 1-period detection is a throughput opt-in,
+    not what a user measuring the paper's law should silently get."""
+
+    def test_ring_detection_defaults_to_pull(self):
+        from swim_tpu.sim import experiments
+
+        out = experiments.detection_study(n=256, engine="ring",
+                                          periods=16, seed=1,
+                                          crash_fraction=0.05)
+        assert out["ring_probe"] == "pull"
+        # pull mode is the geometric-law regime: the mean cannot sit at
+        # rotor's deterministic bound (measured rotor mean: exactly 1.0)
+        assert out["suspect_latency_mean"] > 1.05, out
+
+    def test_rotor_remains_explicit_opt_in(self):
+        from swim_tpu.sim import experiments
+
+        out = experiments.detection_study(n=256, engine="ring",
+                                          periods=16, seed=1,
+                                          crash_fraction=0.05,
+                                          ring_probe="rotor")
+        assert out["ring_probe"] == "rotor"
+        assert out["suspect_latency_mean"] <= 2.0, out
+
+    def test_sharded_layout_defaults_to_pull_too(self):
+        from swim_tpu.sim import experiments
+
+        out = experiments.detection_study(n=256, engine="ringshard",
+                                          periods=16, seed=1,
+                                          crash_fraction=0.05)
+        assert out["ring_probe"] == "pull"
+        assert out["suspect_latency_mean"] > 1.05, out
+
+
 class TestFalsePositiveSuppression:
     """SWIM paper §5.3: the suspicion subprotocol + incarnation refutation
     suppress false positives under message loss — *below the protocol's

@@ -126,6 +126,22 @@ def save_last_good_tpu(out: dict) -> None:
         pass
 
 
+def is_headline_run(on_tpu: bool, head: dict | None, smoke: bool,
+                    info: dict) -> bool:
+    """True iff this run's headline may OVERWRITE the last-known-good
+    TPU record: a real accelerator execution at the headline
+    configuration.  Smoke runs, small --nodes runs, short
+    dispatch-dominated --periods runs, CPU-actual executions, and
+    captures whose backend died mid-run must never update it (they
+    would over- or under-sell the build — the exact failure the record
+    exists to prevent)."""
+    return (on_tpu and head is not None and not smoke
+            and head.get("nodes", 0) >= 1_000_000
+            and head.get("periods", 0) >= 25
+            and head.get("platform_actual") == "tpu"
+            and "backend_died_after" not in info)
+
+
 def load_last_good_tpu() -> dict | None:
     """Load the persisted record minus the bulky full-output echo."""
     try:
@@ -570,19 +586,7 @@ def main() -> int:
         else:
             out[f"{tier}_error"] = r.get("error")
     out.update(info)
-    headline_run = (on_tpu and head is not None and not args.smoke
-                    and head.get("nodes", 0) >= 1_000_000
-                    and head.get("periods", 0) >= 25
-                    and head.get("platform_actual") == "tpu"
-                    and "backend_died_after" not in info)
-    if headline_run:
-        # A real accelerator headline AT THE HEADLINE CONFIGURATION:
-        # persist it as the last-known-good record for future fallback
-        # runs to embed.  Smoke runs, small --nodes runs, short
-        # dispatch-dominated --periods runs, and captures where the
-        # backend died mid-run must NOT overwrite the record (they
-        # would over- or under-sell the build — the exact failure the
-        # record exists to prevent).
+    if is_headline_run(on_tpu, head, args.smoke, info):
         save_last_good_tpu(out)
     elif not on_tpu or head is None or "backend_died_after" in info:
         # CPU fallback or dead backend ONLY: the fallback number must

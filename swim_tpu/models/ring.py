@@ -706,10 +706,17 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     inv_sub = subject[fresh_slots]
     inv_used = inv_sub >= 0
     inv_key = rkey[fresh_slots]
-    fresh_rows = _row_select_multi(
-        cold, [jnp.broadcast_to(jnp.mod(fresh_gw0 + w, g.rw),
-                                cold.shape[1:])
-               for w in range(g.ow)])                  # OW x u32[N]
+    # The OW query rows here are SHARED by every node (static mod
+    # offsets of the traced period), so a contiguous dynamic row slice
+    # reads OW rows (~4 MB each at 1M) instead of streaming the whole
+    # 512 MB cold matrix through a per-node select pass.  (The C+1
+    # view queries below stay one-hot passes — their rows are per-node.
+    # Round-3's strided-walk hazard was WIN column slices and cold row
+    # WRITES; a word-major cold ROW READ is contiguous.)
+    fresh_rows = [
+        jax.lax.dynamic_slice_in_dim(
+            cold, jnp.mod(fresh_gw0 + w, g.rw), 1, axis=0)[0]
+        for w in range(g.ow)]                          # OW x u32[N]
     inv_knowers = ops.gsum(_lane_counts(jnp.stack(fresh_rows), active))
     inv_tomb = inv_used & (inv_knowers >= live_total)
     gone_key = ops.scatter_max(gone_key, jnp.where(inv_tomb, inv_sub, n),

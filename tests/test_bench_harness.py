@@ -57,6 +57,62 @@ class TestTimeRun:
         assert pps > 0
 
 
+class TestTimeRunStepContract:
+    def test_rejects_output_without_step(self):
+        """The execution proof is mandatory (ADVICE r3): an output with
+        no step counter cannot prove the dispatch ran at all."""
+
+        class _NoStep:
+            pass
+
+        with pytest.raises(RuntimeError, match="no .step counter"):
+            bench._time_run(lambda s, i: _NoStep(), _FakeState(step=0),
+                            warmup=0, periods=5)
+
+
+class TestLastGoodTpuGate:
+    """The last-known-good record must only be overwritten by a real
+    headline capture and only embedded on fallback lines (round 4)."""
+
+    def _head(self, **kw):
+        d = {"nodes": 1_000_000, "periods": 100,
+             "platform_actual": "tpu"}
+        d.update(kw)
+        return d
+
+    def _gate(self, on_tpu, head, smoke=False, info=()):
+        return bench.is_headline_run(on_tpu, head, smoke,
+                                     dict.fromkeys(info, True))
+
+    def test_headline_capture_saves(self):
+        assert self._gate(True, self._head())
+
+    def test_smoke_small_short_cpu_or_dead_do_not_save(self):
+        assert not self._gate(True, self._head(), smoke=True)
+        assert not self._gate(True, self._head(nodes=4096))
+        assert not self._gate(True, self._head(periods=2))
+        assert not self._gate(True, self._head(platform_actual="cpu"))
+        assert not self._gate(True, self._head(),
+                              info=["backend_died_after"])
+        assert not self._gate(False, self._head())
+
+    def test_save_and_load_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                            str(tmp_path / "lg.json"))
+        out = {"value": 77.0, "unit": "periods/sec", "metric": "m",
+               "vs_baseline": 0.0077}
+        bench.save_last_good_tpu(out)
+        rec = bench.load_last_good_tpu()
+        assert rec["value"] == 77.0
+        assert "full" not in rec          # bulky echo stripped on load
+        assert rec["commit"] and rec["captured_at"]
+
+    def test_load_missing_returns_none(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                            str(tmp_path / "absent.json"))
+        assert bench.load_last_good_tpu() is None
+
+
 class TestWatcherCaptureChecks:
     def test_bench_payload_check(self):
         from scripts.tpu_watch import _bench_on_tpu

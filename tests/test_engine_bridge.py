@@ -27,7 +27,7 @@ import pytest
 from swim_tpu import SwimConfig
 from swim_tpu.bridge import EngineBridgeServer, ExternalNodeHost
 from swim_tpu.core import codec
-from swim_tpu.types import Status
+from swim_tpu.types import MsgKind, Status
 
 # engine geometry for tests: small knobs = fast compile; the protocol
 # semantics (suspicion, dissemination, refutation) are untouched
@@ -207,6 +207,86 @@ class TestSilentCore:
             bp.write_frame(sock, bp.Frame(bp.BYE))
         finally:
             sock.close()
+            server.join(timeout=30)
+
+
+class TestStalledSession:
+    def test_stalled_session_stops_gating_and_is_crash_gated(self):
+        """A session that keeps its TCP socket open but stops STEPping
+        (hung process) must not freeze engine time for the others: after
+        `stall_timeout` wall seconds it leaves the barrier, the healthy
+        session's STEPs run periods again, and the stalled session's
+        row — silent on mirrored-probe acks — is crash-gated and
+        confirmed dead by the engine (round 4; the multi-session
+        barrier's liveness promise)."""
+        import socket
+        import time
+
+        from swim_tpu.bridge import protocol as bp
+
+        n = 512
+        xa, xb = 100, 200
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_ids=[xa, xb], seed=8,
+                                    ack_grace=2, stall_timeout=1.5)
+        server.start()
+        sa = socket.create_connection(server.address)
+        sb = socket.create_connection(server.address)
+
+        def step(sock, dt, me=None):
+            """STEP and drain the batch; if `me` is set, ack mirrored
+            pings like a live core (liveness credit)."""
+            bp.write_frame(sock, bp.Frame(bp.STEP, t=dt))
+            while True:
+                f = bp.read_frame(sock)
+                if f.op == bp.TIME:
+                    return f.t
+                if f.op == bp.DELIVER and me is not None:
+                    try:
+                        msg = codec.decode(f.payload)
+                    except codec.DecodeError:
+                        continue
+                    if msg.kind == MsgKind.PING:
+                        ack = codec.Message(
+                            kind=MsgKind.ACK, sender=me,
+                            probe_seq=msg.probe_seq,
+                            on_behalf=msg.on_behalf)
+                        bp.write_frame(sock, bp.Frame(
+                            bp.SEND, a=me, b=f.a,
+                            payload=codec.encode(ack)))
+
+        try:
+            bp.write_frame(sa, bp.Frame(bp.HELLO, a=xa))
+            assert bp.read_frame(sa).op == bp.WELCOME
+            bp.write_frame(sb, bp.Frame(bp.HELLO, a=xb))
+            assert bp.read_frame(sb).op == bp.WELCOME
+            # both step together (both acking): engine advances
+            for _ in range(3):
+                step(sa, 1.0, me=xa)
+                step(sb, 1.0, me=xb)
+            t_joint = server.t
+            assert t_joint >= 2
+            # A goes silent (socket open, no frames).  B keeps
+            # stepping: at first the barrier holds time still...
+            step(sb, 1.0, me=xb)
+            t_frozen = server.t
+            # ...then A exceeds stall_timeout and stops gating
+            time.sleep(2.0)
+            for _ in range(25):
+                step(sb, 1.0, me=xb)
+            assert server.t > t_frozen, (
+                "engine time stayed frozen behind the stalled session")
+            # the stalled core's row died organically
+            assert server._ext_crashed[xa], "stalled core never gated"
+            assert dead_view_of(server, xa), (
+                f"stalled core not confirmed: "
+                f"{[hex(k) for k in server.table_keys(xa)]}")
+            assert not server._ext_crashed[xb]
+            bp.write_frame(sb, bp.Frame(bp.BYE))
+        finally:
+            sa.close()
+            sb.close()
+            server.close()
             server.join(timeout=30)
 
 

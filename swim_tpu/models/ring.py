@@ -515,6 +515,56 @@ def _lane_counts(words: jax.Array, active: jax.Array) -> jax.Array:
     return jnp.sum(masked, axis=2).reshape(ow * WORD)
 
 
+def _window_overlay(g: RingGeometry, step) -> tuple[jax.Array, jax.Array]:
+    """(in_win bool[RW], wcol i32[RW]): which ring words are currently
+    window-resident after `step` completed periods, and which win column
+    holds each — THE single home of the win/cold overlay invariant
+    (consumed by resolved_words and live_knower_counts; the slot-
+    arithmetic comment block above is the derivation)."""
+    first_gw = step * g.ow - g.ww          # win col 0 after the last step
+    win_ring0 = jnp.mod(first_gw, g.rw)
+    word_off = jnp.mod(jnp.arange(g.rw, dtype=jnp.int32) - win_ring0,
+                       g.rw)
+    return word_off < g.ww, jnp.clip(word_off, 0, g.ww - 1)
+
+
+def live_knower_counts(cfg: SwimConfig, state: RingState,
+                       up: jax.Array,
+                       chunk_words: int | None = None) -> jax.Array:
+    """i32[R]: per-ring-slot count of live ("up") nodes holding the bit.
+
+    The study runner's census.  Computed split by storage (win vs cold)
+    in CHUNKS of word rows so the expanded [chunk, 32, N] intermediate
+    stays ~2 GiB however large N·RW grows: the previous formulation
+    expanded resolved_words to [N, RW, 32] in one piece, which CPU XLA
+    MATERIALIZES — 115 GB at 4M nodes / OW=4, and a 245 GB
+    RESOURCE_EXHAUSTED at OW=8 (TPU fuses it, but the chunked form is
+    layout-native there too: cold row chunks are contiguous in the
+    word-major [RW, N] layout).  Integer sums — bitwise-identical to
+    the unchunked census in any chunk order.
+    """
+    g = geometry(cfg)
+    n = cfg.n_nodes
+
+    def counts_of(rows):                        # [cw, N] word-major
+        # _lane_counts IS this census kernel; reuse it per chunk
+        return _lane_counts(rows, up).reshape(-1, WORD)
+
+    # 2^23 word-node pairs x (4 B u32 bits + 4 B i32 masked) x 32 bits
+    # ~= 2 GiB of expanded intermediates per chunk
+    cw = chunk_words or max(1, (1 << 23) // max(n, 1))
+    counts_cold = jnp.concatenate(
+        [counts_of(state.cold[c:c + cw]) for c in range(0, g.rw, cw)])
+    win_t = state.win.T                         # [WW, N]
+    counts_win = jnp.concatenate(
+        [counts_of(win_t[c:c + cw]) for c in range(0, g.ww, cw)])
+    # overlay: window-resident ring words read their win column (cold's
+    # copy of a window column is one generation stale by design)
+    in_win, wcol = _window_overlay(g, state.step)
+    counts = jnp.where(in_win[:, None], counts_win[wcol], counts_cold)
+    return counts.reshape(g.rw * WORD)
+
+
 def resolved_words(cfg: SwimConfig, state: RingState) -> jax.Array:
     """u32[N, RW]: the CURRENT heard-bits of every ring word.
 
@@ -524,11 +574,7 @@ def resolved_words(cfg: SwimConfig, state: RingState) -> jax.Array:
     metrics) must use this instead of re-deriving the layout.
     """
     g = geometry(cfg)
-    first_gw = state.step * g.ow - g.ww       # win col 0 after the last step
-    win_ring0 = jnp.mod(first_gw, g.rw)
-    word_off = jnp.mod(jnp.arange(g.rw, dtype=jnp.int32) - win_ring0, g.rw)
-    in_win = word_off < g.ww
-    wcol = jnp.clip(word_off, 0, g.ww - 1)
+    in_win, wcol = _window_overlay(g, state.step)
     return jnp.where(in_win[None, :], state.win[:, wcol], state.cold.T)
 
 

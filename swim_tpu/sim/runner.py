@@ -251,8 +251,6 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
     from swim_tpu.models import ring as ring_mod
 
     n = cfg.n_nodes
-    g = ring_mod.geometry(cfg)
-    r_tot = g.rw * ring_mod.WORD
     track0 = StudyTrack(*(jnp.full((n,), NEVER, jnp.int32)
                           for _ in range(3)))
 
@@ -267,15 +265,11 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
         crashed = t >= plan.crash_step
         up = ~crashed & (t >= plan.join_step)
 
-        # per-slot live-knower counts from the packed planes (layout
-        # resolution owned by ring.resolved_words); the bit-unpack fuses
-        # into the reduction
-        words = ring_mod.resolved_words(cfg, st)
-        live_words = jnp.where(up[:, None], words, jnp.uint32(0))
-        bits = (live_words[:, :, None]
-                >> jnp.arange(ring_mod.WORD, dtype=jnp.uint32)[None, None, :]
-                ) & jnp.uint32(1)
-        knowers = jnp.sum(bits, axis=0).reshape(r_tot).astype(jnp.int32)
+        # per-slot live-knower counts (layout resolution owned by
+        # ring.live_knower_counts — chunked so the bit-plane expansion
+        # stays bounded at any N; see its docstring for the 4M-node
+        # CPU RESOURCE_EXHAUSTED this replaces)
+        knowers = ring_mod.live_knower_counts(cfg, st, up)
 
         gone = st.gone_key
         gone_not_alive = lattice.is_suspect(gone) | lattice.is_dead(gone)

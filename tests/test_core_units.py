@@ -248,6 +248,46 @@ class TestTopKVals:
         np.testing.assert_array_equal(a, b)
 
 
+class TestLiveKnowerCounts:
+    """ring.live_knower_counts (the chunked study census) must equal the
+    unchunked reference formulation — the [N, RW, 32] expansion it
+    replaced for memory reasons — bit for bit, across periods and chunk
+    boundaries (cw < RW at this N forces multiple chunks)."""
+
+    def test_matches_unchunked_census(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu import SwimConfig
+        from swim_tpu.models import ring
+        from swim_tpu.sim import faults
+
+        n = 4096
+        cfg = SwimConfig(n_nodes=n, k_indirect=1, max_piggyback=4,
+                         ring_window_periods=3)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 70], [1, 2]), 0.05)
+        state = ring.init_state(cfg)
+        key = jax.random.key(2)
+        step = jax.jit(lambda s, r: ring.step(cfg, s, plan, r))
+        g = ring.geometry(cfg)
+        for t in range(6):
+            state = step(state, ring.draw_period_ring(key, t, cfg))
+            up = jnp.asarray(~(t >= np.asarray(plan.crash_step)))
+            # chunk_words=3 forces multiple, unevenly-dividing chunks
+            got = np.asarray(ring.live_knower_counts(cfg, state, up,
+                                                     chunk_words=3))
+            words = ring.resolved_words(cfg, state)
+            live_words = jnp.where(up[:, None], words, jnp.uint32(0))
+            bits = (live_words[:, :, None]
+                    >> jnp.arange(32, dtype=jnp.uint32)[None, None, :]
+                    ) & jnp.uint32(1)
+            want = np.asarray(
+                jnp.sum(bits, axis=0).reshape(g.rw * 32).astype(jnp.int32))
+            np.testing.assert_array_equal(got, want, err_msg=f"t={t}")
+
+
 class TestFirstTrueIdx:
     """ring._first_true_idx is the sort-free compaction behind both
     layouts' first_true_nodes (round 4).  Its contract is exact: the

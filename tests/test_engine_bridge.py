@@ -210,6 +210,44 @@ class TestSilentCore:
             server.join(timeout=30)
 
 
+class TestTwoNodesOneSession:
+    def test_external_host_drives_two_ids_on_one_connection(self):
+        """ExternalNodeHost's multi-HELLO pattern against the ENGINE
+        server (one TCP session owning two external ids): both Python
+        cores join, co-simulate against the tensor cluster, detect an
+        injected tensor-peer crash, and stay alive in the engine's
+        eyes."""
+        n = 2048
+        xa, xb = n - 1, n - 2
+        victim = 128                   # in the join snapshot (stride 16)
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_ids=[xa, xb], seed=21)
+        server.start()
+        host = ExternalNodeHost(server.address, quantum=0.25)
+        try:
+            na = host.add_node(SwimConfig(n_nodes=n, **GEOM), xa,
+                               seeds=[7], seed=5)
+            nb = host.add_node(SwimConfig(n_nodes=n, **GEOM), xb,
+                               seeds=[9], seed=6)
+            host.run(6.0)
+            assert len(na.members.ids()) >= 16
+            assert len(nb.members.ids()) >= 16
+            host.kill(victim)
+            host.run(24.0)
+            for node in (na, nb):
+                op = node.members.opinion(victim)
+                assert op is not None and op.status == Status.DEAD, (
+                    node.id, op)
+            assert not server._ext_crashed[xa]
+            assert not server._ext_crashed[xb]
+            assert not dead_view_of(server, xa)
+            assert not dead_view_of(server, xb)
+        finally:
+            host.close()
+            server.close()
+            server.join(timeout=30)
+
+
 class TestStalledSession:
     def test_stalled_session_stops_gating_and_is_crash_gated(self):
         """A session that keeps its TCP socket open but stops STEPping

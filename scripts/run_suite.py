@@ -1,0 +1,105 @@
+"""Run the test suite with per-file process isolation.
+
+One pytest process per tests/test_*.py file.  Motivation (round 4): a
+single-process run of all 22 files segfaulted inside a pjit dispatch
+around test ~145 (jaxlib CPU client, after hundreds of compiled
+executables accumulated in one interpreter) while every file passes in
+isolation.  No pytest-xdist/pytest-forked in this image, so this script
+is the isolation layer: a crash in one file is contained, attributed,
+and reported as that file's failure instead of killing the whole run.
+
+Env handling: tests/conftest.py already forces the 8-device virtual CPU
+mesh; this script only scrubs PALLAS_AXON_POOL_IPS so a dead axon TPU
+tunnel cannot hang interpreter startup (sitecustomize dials it when the
+var is set).
+
+Usage: python scripts/run_suite.py [--timeout-per-file S] [pattern]
+Exit 0 iff every file's pytest exited 0.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("pattern", nargs="?", default="tests/test_*.py")
+    ap.add_argument("--timeout-per-file", type=float, default=2400.0)
+    args = ap.parse_args()
+
+    files = sorted(glob.glob(os.path.join(REPO, args.pattern)))
+    if not files:
+        print(f"no test files match {args.pattern}", file=sys.stderr)
+        return 2
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+
+    failures: list[str] = []
+    t_all = time.time()
+    for path in files:
+        rel = os.path.relpath(path, REPO)
+        t0 = time.time()
+        stdout = stderr = ""
+        rc: int | None = None  # None = timeout sentinel (never a real rc)
+        # New session so a timeout can kill the whole process GROUP —
+        # test-spawned grandchildren (e.g. bridge_client subprocesses)
+        # included, not just the direct pytest child.
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pytest", rel, "-q", "--no-header"],
+            cwd=REPO, env=env, text=True, start_new_session=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        try:
+            stdout, stderr = p.communicate(timeout=args.timeout_per_file)
+            rc = p.returncode
+            tail = (stdout or "").strip().splitlines()
+            summary = tail[-1] if tail else "(no output)"
+        except subprocess.TimeoutExpired:
+            summary = f"TIMEOUT after {args.timeout_per_file:.0f}s"
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                stdout, stderr = p.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                # A grandchild re-setsid'd out of the group and holds the
+                # pipes; abandon the read rather than wedge the runner.
+                p.kill()
+                stdout, stderr = "", "(pipes wedged after group kill)"
+        dt = time.time() - t0
+        if rc == 0:
+            print(f"PASS {rel:40s} {dt:7.1f}s  {summary}", flush=True)
+        else:
+            # Negative rc = killed by signal (e.g. -11 segfault): name it.
+            sig = ""
+            if rc is not None and rc < 0:
+                try:
+                    sig = f" ({signal.strsignal(-rc) or 'unknown signal'})"
+                except ValueError:
+                    sig = " (unknown signal)"
+            print(f"FAIL {rel:40s} {dt:7.1f}s  rc={rc}{sig}  {summary}",
+                  flush=True)
+            for label, text in (("stdout", stdout), ("stderr", stderr)):
+                chunk = text.strip().splitlines()[-15:]
+                if chunk:
+                    print(f"  --- {rel} {label} tail ---", flush=True)
+                    for line in chunk:
+                        print(f"  {line}", flush=True)
+            failures.append(rel)
+    print(f"\n{len(files) - len(failures)}/{len(files)} files green "
+          f"in {time.time() - t_all:.0f}s"
+          + (f"; FAILED: {', '.join(failures)}" if failures else ""))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

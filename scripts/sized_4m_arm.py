@@ -1,0 +1,11 @@
+import json
+from swim_tpu.sim import experiments
+
+# Geometry-scaled twin of study_suspicion_4m_cpu.json: OB=128 (>= the
+# ~106 originations/period demand at 4M).  OW=8 OOM'd the CPU host's
+# study summary; OB=128 is the smallest power-of-two budget above
+# demand and halves the ring footprint.
+out = experiments.suspicion_sweep(
+    n=4_000_000, mults=(2.0,), losses=(0.02,), crash_fraction=0.0002,
+    periods=60, seed=0, engine="ringshard", ring_orig_words=4)
+print(json.dumps(out))

@@ -1,0 +1,38 @@
+"""Shared helper for the real-socket tests: deadline-polled convergence.
+
+The UDP end-to-end tests (tests/test_udp.py, tests/test_native.py) run
+five real nodes at 50 ms protocol periods; convergence normally lands in
+well under a second, but a fixed sleep flakes on the contended 1-core CI
+host (observed: a node still alone after 1.5 s).  Polling with a generous
+deadline keeps the fast path fast and the assertion deterministic: the
+caller re-asserts the condition after the wait, so a timeout still fails
+with the informative per-node message.
+"""
+
+import asyncio
+
+
+async def wait_until(cond, timeout: float = 30.0, interval: float = 0.05):
+    """Poll `cond()` until true or `timeout` elapses (no raise on timeout)."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not cond() and loop.time() < deadline:
+        await asyncio.sleep(interval)
+
+
+def all_see(nodes, count, status=None):
+    """True iff every node sees `count` members (all with `status`, if given)."""
+    for n in nodes:
+        if len(n.members) != count:
+            return False
+        if status is not None and any(
+                (op := n.members.opinion(m)) is None or op.status != status
+                for m in range(count)):
+            return False
+    return True
+
+
+def all_judge(nodes, victim, status):
+    """True iff every node's opinion of `victim` is exactly `status`."""
+    return all((op := n.members.opinion(victim)) is not None
+               and op.status == status for n in nodes)

@@ -16,6 +16,8 @@ from swim_tpu.core.codec import Message, WireUpdate
 from swim_tpu.native import available
 from swim_tpu.types import MsgKind, Status
 
+from _net import all_judge, all_see, wait_until  # tests/ is on sys.path
+
 HAVE = available()
 needs_codec = pytest.mark.skipif(not HAVE["codec"],
                                  reason="no native toolchain")
@@ -177,12 +179,15 @@ class TestNativeUDP:
             nodes[0].start()
             for n in nodes[1:]:
                 n.start(seeds=[transports[0].local_address])
-            await asyncio.sleep(1.5)
+            # deadline-polled convergence (see tests/_net.py): a fixed
+            # 1.5 s sleep flaked on the contended 1-core CI host
+            await wait_until(lambda: all_see(nodes, 5))
             for n in nodes:
                 assert len(n.members) == 5, (n.id, len(n.members))
             nodes[4].stop()
             transports[4].close()
-            await asyncio.sleep(2.0)
+
+            await wait_until(lambda: all_judge(nodes[:4], 4, Status.DEAD))
             for n in nodes[:4]:
                 op = n.members.opinion(4)
                 assert op is not None and op.status == Status.DEAD

@@ -12,6 +12,8 @@ from swim_tpu.core.clock import AsyncioClock
 from swim_tpu.core.node import Node
 from swim_tpu.core.transport import UDPTransport
 
+from _net import all_judge, all_see, wait_until  # tests/ is on sys.path
+
 
 def test_udp_cluster_join_converge_detect():
     async def scenario():
@@ -26,7 +28,10 @@ def test_udp_cluster_join_converge_detect():
         nodes[0].start()
         for n in nodes[1:]:
             n.start(seeds=[seed_addr])
-        await asyncio.sleep(1.5)  # ~30 periods: join + gossip convergence
+        # join + gossip convergence: normally well under 1 s at 50 ms
+        # periods; deadline-polled (full condition, transient SUSPECTs
+        # included) so host contention cannot flake it
+        await wait_until(lambda: all_see(nodes, 5, Status.ALIVE))
         for n in nodes:
             assert len(n.members) == 5, (n.id, len(n.members))
             for m in range(5):
@@ -36,7 +41,9 @@ def test_udp_cluster_join_converge_detect():
         # crash-stop node 4 (close its socket, stop timers)
         nodes[4].stop()
         transports[4].close()
-        await asyncio.sleep(2.0)  # detect + suspicion (2*log10(5)→2 periods)
+
+        # detect + suspicion expiry (2*log10(5) → 2 periods), deadline-polled
+        await wait_until(lambda: all_judge(nodes[:4], 4, Status.DEAD))
         for n in nodes[:4]:
             op = n.members.opinion(4)
             assert op is not None and op.status == Status.DEAD, (n.id, op)

@@ -103,6 +103,7 @@ class _Session:
         self.outq: list[bp.Frame] = []
         self.live = True
         self.last_step_wall = time.monotonic()
+        self.step_pending = False        # STEP read, waiting on _engine
 
 
 class EngineBridgeServer:
@@ -171,6 +172,7 @@ class EngineBridgeServer:
         self._loss = 0.0
         self._plan = None
         self._plan_dirty = True
+        self._plan_gen = 0               # bumped on every fault mutation
         self._step = jax.jit(functools.partial(ring.step, cfg))
         # injections queued for the next period boundary
         self._inject: list[tuple[int, int, int, int]] = []  # subj,key,org,hear
@@ -182,6 +184,17 @@ class EngineBridgeServer:
         # per-external-id seam state
         self._prev_rows: dict[int, np.ndarray] = {}
         self._last_acks: dict[int, int] = {}
+        # ack-opportunity accounting for the liveness gate: a "ping
+        # flush" is one outq flush that carried >=1 mirrored ping for
+        # the id — the only events the core can possibly ack.  After an
+        # id joins, all three dicts are mutated under self._engine
+        # (STEP/SEND handlers and _run_period all hold it); the HELLO
+        # handler initializes the id's keys under self._lock alone,
+        # which is safe only because the id is not yet in _prev_rows
+        # (the gate's iteration set) at that point.
+        self._ping_pending: dict[int, bool] = {}   # queued, not flushed
+        self._ping_flushes: dict[int, int] = {}    # flushes with pings
+        self._ack_flush: dict[int, int] = {}       # _ping_flushes @ ack
         self._ext_crashed: dict[int, bool] = {x: False for x in self.xs}
         self._owner: dict[int, _Session] = {}    # joined id -> session
         self._claimed: set[int] = set()          # ids ever HELLO'd
@@ -284,21 +297,36 @@ class EngineBridgeServer:
 
     # ------------------------------------------------------------- protocol
 
+    def _session_gates(self, s: _Session, now: float) -> bool:
+        """Whether session s gates the barrier: live, joined, and not
+        wall-clock-stalled.  Shared by _gating_clocks and _run_period's
+        crash-gate — the two MUST agree, or a session could keep gating
+        (and flushing) while the crash-gate judges it non-gating and
+        applies the engine-time lag to its healthy ids.  Only STEP
+        frames refresh the wall stamp (a wedged client spamming SENDs
+        must still stall out), and a session whose STEP is read but
+        queued behind the engine lock (step_pending) always gates — it
+        is provably alive with a clock advance in flight, however long
+        the current period run holds the lock.  Caller holds
+        self._lock."""
+        return bool(s.live and s.ids
+                    and (s.step_pending
+                         or now - s.last_step_wall <= self.stall_timeout))
+
     def _gating_clocks(self) -> list[float]:
-        """Virtual clocks of the sessions that gate the barrier: live,
-        joined, and not wall-clock-stalled.  A session that keeps its
-        socket open but stops STEPping (hung process) would otherwise
-        freeze engine time forever AND dodge the ack_grace crash-gate
-        (which only runs inside _run_period) — after `stall_timeout`
-        wall seconds without a STEP it stops gating; its rows then miss
-        their mirrored-probe acks and die organically.  Caller holds
+        """Virtual clocks of the sessions that gate the barrier (see
+        _session_gates).  A session that keeps its socket open but
+        stops STEPping (hung process) would otherwise freeze engine
+        time forever AND dodge the ack_grace crash-gate (which only
+        runs inside _run_period) — after `stall_timeout` wall seconds
+        without a STEP it stops gating; its rows then miss their
+        mirrored-probe acks and die organically.  Caller holds
         self._lock."""
         import time
 
         now = time.monotonic()
         return [s.clock for s in self._sessions
-                if s.live and s.ids
-                and now - s.last_step_wall <= self.stall_timeout]
+                if self._session_gates(s, now)]
 
     def _handle(self, sess: _Session, f: bp.Frame) -> None:
         if f.op == bp.HELLO:
@@ -309,6 +337,9 @@ class EngineBridgeServer:
                     self._owner[f.a] = sess
                     sess.ids.append(f.a)
                     self._last_acks[f.a] = self.t
+                    self._ping_pending[f.a] = False
+                    self._ping_flushes[f.a] = 0
+                    self._ack_flush[f.a] = 0
                     # join pins this session's clock at engine time
                     sess.clock = max(
                         sess.clock, self.t * self.cfg.protocol_period)
@@ -333,9 +364,20 @@ class EngineBridgeServer:
         elif f.op == bp.STEP:
             import time
 
+            # stamp at STEP READ time, before the engine lock: a session
+            # queued behind a slow period run (e.g. the first-period XLA
+            # compile) must not be charged the server's own lock hold.
+            # step_pending additionally marks it as provably alive WITH
+            # an un-processed clock advance, so even a hold longer than
+            # stall_timeout cannot wall-stall it out of the barrier and
+            # into the engine-time crash-gate.
+            with self._lock:
+                sess.last_step_wall = time.monotonic()
+                sess.step_pending = True
             with self._engine:
                 with self._lock:
                     sess.clock += f.t
+                    sess.step_pending = False
                     sess.last_step_wall = time.monotonic()
                 # conservative barrier: run whole periods while EVERY
                 # gating session has crossed the next boundary
@@ -348,14 +390,24 @@ class EngineBridgeServer:
                     self._run_period()
                 with self._lock:
                     flush, sess.outq = sess.outq, []
+                    # the ack-grace clock for this session's ids ticks
+                    # on DELIVERED pings, not engine time: mirrored
+                    # pings still queued here cannot have been acked
+                    # (see _run_period's liveness gate)
+                    for x in sess.ids:
+                        if self._ping_pending.get(x):
+                            self._ping_pending[x] = False
+                            self._ping_flushes[x] += 1
             for fr in flush:
                 bp.write_frame(sess.sock, fr)
             bp.write_frame(sess.sock, bp.Frame(bp.TIME, t=sess.clock))
         elif f.op == bp.KILL:
             self.kill(f.a)
         elif f.op == bp.SET_LOSS:
-            self._loss = float(f.t)
-            self._plan_dirty = True
+            with self._lock:
+                self._loss = float(f.t)
+                self._plan_dirty = True
+                self._plan_gen += 1
 
     # --------------------------------------------------------- fault wiring
 
@@ -364,25 +416,40 @@ class EngineBridgeServer:
             if 0 <= node_id < self.n and self._crash[node_id] > self.t:
                 self._crash[node_id] = self.t
                 self._plan_dirty = True
+                self._plan_gen += 1
 
     def _alive(self, node_id: int) -> bool:
         return (0 <= node_id < self.n and self._crash[node_id] > self.t
                 and self._join[node_id] <= self.t)
 
     def _device_plan(self):
-        if self._plan_dirty or self._plan is None:
+        # generation-checked rebuild: a concurrent kill()/SET_LOSS on
+        # another session's thread landing after the snapshot must not
+        # have its dirty mark erased (lost update), and an exception
+        # during the build must leave the flag set so the next period
+        # retries instead of silently running on a stale plan
+        with self._lock:
+            rebuild = self._plan_dirty or self._plan is None
+            gen = self._plan_gen
+            if rebuild:
+                crash = self._crash.copy()
+                join = self._join.copy()
+                loss = self._loss
+        if rebuild:
             import jax.numpy as jnp
 
             from swim_tpu.sim.faults import FaultPlan
 
             self._plan = FaultPlan(
-                crash_step=jnp.asarray(self._crash),
-                loss=jnp.float32(self._loss),
+                crash_step=jnp.asarray(crash),
+                loss=jnp.float32(loss),
                 partition_id=jnp.zeros((self.n,), jnp.int32),
                 partition_start=jnp.int32(1 << 30),
                 partition_end=jnp.int32(1 << 30),
-                join_step=jnp.asarray(self._join))
-            self._plan_dirty = False
+                join_step=jnp.asarray(join))
+            with self._lock:
+                if self._plan_gen == gen:
+                    self._plan_dirty = False
         return self._plan
 
     # -------------------------------------------------------- inbound seam
@@ -398,6 +465,11 @@ class EngineBridgeServer:
             org = u.origin if 0 <= u.origin < self.n else hearer
             with self._lock:
                 self._inject.append((u.member, key, org, hearer))
+
+    def _credit_ack(self, x: int) -> None:
+        """Liveness credit for external id x (caller holds _engine)."""
+        self._last_acks[x] = self.t
+        self._ack_flush[x] = self._ping_flushes.get(x, 0)
 
     def _lost(self) -> bool:
         """Bernoulli loss draw for one bridge datagram leg (D4): the
@@ -418,6 +490,17 @@ class EngineBridgeServer:
         if owner_live and dst != src:
             if self._lost():
                 return
+            # the mirrored rotor prober of an external id can itself be
+            # another external id; the probed core's ACK then rides this
+            # hub path instead of the engine seam below, and must earn
+            # the same liveness credit (the recipient core ignores an
+            # ACK with a probe_seq it never issued).  Header-only peek:
+            # the hub must not pay a full gossip parse per datagram.
+            try:
+                if codec.peek_kind(payload) == MsgKind.ACK:
+                    self._credit_ack(src)
+            except codec.DecodeError:
+                pass
             with self._lock:
                 owner.outq.append(bp.Frame(bp.DELIVER, a=src, b=dst,
                                            payload=payload))
@@ -433,7 +516,7 @@ class EngineBridgeServer:
         if msg.kind == MsgKind.ACK:
             # the core answered a mirrored ping: liveness credit for
             # the sending external id
-            self._last_acks[src] = self.t
+            self._credit_ack(src)
         elif msg.kind == MsgKind.PING:
             if self._lost():             # ack leg draws its own loss
                 return
@@ -472,14 +555,38 @@ class EngineBridgeServer:
     # -------------------------------------------------------- outbound seam
 
     def _run_period(self) -> None:
+        import time
+
         import jax
 
         from swim_tpu.models import ring
 
-        # liveness gate: a silent core is a crashed member (per id)
+        # liveness gate: a silent core is a crashed member (per id).
+        # For a gating session the grace clock ticks on ACK
+        # OPPORTUNITIES — outq flushes that actually carried mirrored
+        # pings — not on engine time: a healthy session cannot ack
+        # pings still queued in its outq (they flush only at its own
+        # STEP), so a multi-period catch-up burst by a lagging session
+        # queues many pings but is exactly ONE opportunity, and cannot
+        # crash-gate anyone.  A session that stopped gating
+        # (disconnected, or wall-stalled per _gating_clocks) never
+        # flushes again, so for it the clock falls back to engine
+        # periods since its last ack — the documented organic-death
+        # path for hung/departed cores.
+        now = time.monotonic()
         for x in list(self._prev_rows):
-            if (not self._ext_crashed[x]
-                    and self.t - self._last_acks[x] > self.ack_grace):
+            if self._ext_crashed[x]:
+                continue
+            with self._lock:
+                owner = self._owner.get(x)
+                gating = (owner is not None
+                          and self._session_gates(owner, now))
+            if gating:
+                lag = (self._ping_flushes.get(x, 0)
+                       - self._ack_flush.get(x, 0))
+            else:
+                lag = self.t - self._last_acks[x]
+            if lag > self.ack_grace:
                 self.kill(x)
                 self._ext_crashed[x] = True
         ext = ring.ext_none(self.ext_capacity)
@@ -520,6 +627,7 @@ class EngineBridgeServer:
             if not self._alive(prober):
                 continue                 # no probe of x this period
             updates = self._slots_to_updates(np.nonzero(fresh)[0], prober)
+            self._ping_pending[x] = True     # ack opportunity at next flush
             for chunk in range(0, max(len(updates), 1), 255):
                 ping = codec.Message(
                     kind=MsgKind.PING, sender=prober, probe_seq=self.t,

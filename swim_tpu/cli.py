@@ -338,12 +338,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "arms (budget-vs-LHA attribution)")
     st.add_argument("--probe", choices=("rotor", "pull"), default=None,
                     help="ring probe pattern override. The detection "
-                         "study defaults the single-program ring engine "
-                         "to 'pull' (law-preserving uniform probing — "
-                         "the paper's e/(e-1) regime); pass 'rotor' to "
-                         "opt into the bounded-detection throughput "
-                         "mode (deviation R1). Other studies and the "
-                         "sharded layout default to rotor.")
+                         "study defaults BOTH ring layouts (ring and "
+                         "ringshard) to 'pull' (law-preserving uniform "
+                         "probing — the paper's e/(e-1) regime); pass "
+                         "'rotor' to opt into the bounded-detection "
+                         "throughput mode (deviation R1). Other "
+                         "studies default to rotor.")
     st.set_defaults(fn=_cmd_study)
 
     br = sub.add_parser(

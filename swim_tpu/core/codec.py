@@ -105,6 +105,24 @@ class DecodeError(ValueError):
     pass
 
 
+def peek_kind(buf: bytes) -> MsgKind:
+    """Header-only kind extraction (magic/version validated, body not
+    parsed) — for hot paths that route on kind without needing the
+    gossip payload (e.g. the bridge hub's ACK liveness credit)."""
+    try:
+        magic, version, kind, _ = _HDR.unpack_from(buf, 0)
+    except struct.error as e:
+        raise DecodeError(str(e)) from e
+    if magic != MAGIC:
+        raise DecodeError("bad magic")
+    if version != VERSION:
+        raise DecodeError(f"unsupported version {version}")
+    try:
+        return MsgKind(kind)
+    except ValueError as e:
+        raise DecodeError(str(e)) from e
+
+
 def decode(buf: bytes) -> Message:
     try:
         magic, version, kind, sender = _HDR.unpack_from(buf, 0)

@@ -40,8 +40,12 @@ sockets (SURVEY.md §1, tree unavailable — §0); this module is the
 TPU-native analog of its network fan-out, with XLA collectives over
 ICI/DCN in place of UDP datagrams.
 
-Pull-uniform probing (`cfg.ring_probe == "pull"`) needs arbitrary-row
-gathers and is not supported here; the rotor flagship is.
+Pull-uniform probing (`cfg.ring_probe == "pull"`) is supported (round
+4): its random-peer reads route through nodewise ring-pass exchanges —
+each shard's query bundle collective-permutes around the device ring,
+answered from the holding shard — bitwise-equal to the single-program
+pull engine (tests/test_ring_shard.py).  Deliberately not the
+throughput path; the rotor flagship remains the fast mode.
 """
 
 from __future__ import annotations

@@ -52,6 +52,29 @@ def dead_view_of(server, member):
     return any(k >> 31 for k in keys)
 
 
+def step_session(sock, dt, me=None):
+    """Raw-socket STEP: advance dt, drain the flush; if `me` is set,
+    ack mirrored pings like a live core (liveness credit)."""
+    from swim_tpu.bridge import protocol as bp
+
+    bp.write_frame(sock, bp.Frame(bp.STEP, t=dt))
+    while True:
+        f = bp.read_frame(sock)
+        if f.op == bp.TIME:
+            return f.t
+        if f.op == bp.DELIVER and me is not None:
+            try:
+                msg = codec.decode(f.payload)
+            except codec.DecodeError:
+                continue
+            if msg.kind == MsgKind.PING:
+                ack = codec.Message(kind=MsgKind.ACK, sender=me,
+                                    probe_seq=msg.probe_seq,
+                                    on_behalf=msg.on_behalf)
+                bp.write_frame(sock, bp.Frame(
+                    bp.SEND, a=me, b=f.a, payload=codec.encode(ack)))
+
+
 class TestHostMirrors:
     def test_resolved_row_matches_canonical_layout(self):
         """engine_server re-derives the win/cold ring-word layout
@@ -271,28 +294,6 @@ class TestStalledSession:
         sa = socket.create_connection(server.address)
         sb = socket.create_connection(server.address)
 
-        def step(sock, dt, me=None):
-            """STEP and drain the batch; if `me` is set, ack mirrored
-            pings like a live core (liveness credit)."""
-            bp.write_frame(sock, bp.Frame(bp.STEP, t=dt))
-            while True:
-                f = bp.read_frame(sock)
-                if f.op == bp.TIME:
-                    return f.t
-                if f.op == bp.DELIVER and me is not None:
-                    try:
-                        msg = codec.decode(f.payload)
-                    except codec.DecodeError:
-                        continue
-                    if msg.kind == MsgKind.PING:
-                        ack = codec.Message(
-                            kind=MsgKind.ACK, sender=me,
-                            probe_seq=msg.probe_seq,
-                            on_behalf=msg.on_behalf)
-                        bp.write_frame(sock, bp.Frame(
-                            bp.SEND, a=me, b=f.a,
-                            payload=codec.encode(ack)))
-
         try:
             bp.write_frame(sa, bp.Frame(bp.HELLO, a=xa))
             assert bp.read_frame(sa).op == bp.WELCOME
@@ -300,18 +301,18 @@ class TestStalledSession:
             assert bp.read_frame(sb).op == bp.WELCOME
             # both step together (both acking): engine advances
             for _ in range(3):
-                step(sa, 1.0, me=xa)
-                step(sb, 1.0, me=xb)
+                step_session(sa, 1.0, me=xa)
+                step_session(sb, 1.0, me=xb)
             t_joint = server.t
             assert t_joint >= 2
             # A goes silent (socket open, no frames).  B keeps
             # stepping: at first the barrier holds time still...
-            step(sb, 1.0, me=xb)
+            step_session(sb, 1.0, me=xb)
             t_frozen = server.t
             # ...then A exceeds stall_timeout and stops gating
             time.sleep(2.0)
             for _ in range(25):
-                step(sb, 1.0, me=xb)
+                step_session(sb, 1.0, me=xb)
             assert server.t > t_frozen, (
                 "engine time stayed frozen behind the stalled session")
             # the stalled core's row died organically
@@ -320,6 +321,61 @@ class TestStalledSession:
                 f"stalled core not confirmed: "
                 f"{[hex(k) for k in server.table_keys(xa)]}")
             assert not server._ext_crashed[xb]
+            bp.write_frame(sb, bp.Frame(bp.BYE))
+        finally:
+            sa.close()
+            sb.close()
+            server.close()
+            server.join(timeout=30)
+
+
+class TestCatchUpBurst:
+    def test_lagging_session_burst_does_not_crash_gate_the_other(self):
+        """When session A lags and then catches up in one STEP, the
+        barrier runs a multi-period burst.  Session B's mirrored pings
+        for those periods are still queued in B's outq (they flush only
+        at B's own STEP), so B cannot possibly have acked them — the
+        ack-grace gate must not count periods B never received (round
+        4 review: pre-fix, the gate compared engine time against
+        B's last ack and killed the healthy core mid-burst)."""
+        import socket
+
+        from swim_tpu.bridge import protocol as bp
+
+        n = 512
+        xa, xb = 100, 200
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_ids=[xa, xb], seed=11,
+                                    ack_grace=2, stall_timeout=120.0)
+        server.start()
+        sa = socket.create_connection(server.address)
+        sb = socket.create_connection(server.address)
+        try:
+            bp.write_frame(sa, bp.Frame(bp.HELLO, a=xa))
+            assert bp.read_frame(sa).op == bp.WELCOME
+            bp.write_frame(sb, bp.Frame(bp.HELLO, a=xb))
+            assert bp.read_frame(sb).op == bp.WELCOME
+            # B races 6 periods ahead; the conservative barrier holds
+            # engine time frozen behind A
+            for _ in range(6):
+                step_session(sb, 1.0, me=xb)
+            assert server.t == 0, "barrier did not hold behind A"
+            # A catches up in ONE step: a ~6-period burst, well past
+            # ack_grace=2.  B must survive it.
+            step_session(sa, 6.0, me=xa)
+            assert server.t >= 5, "catch-up burst did not run"
+            assert not server._ext_crashed[xb], (
+                "healthy lagging-delivery session was crash-gated by "
+                "the catch-up burst")
+            assert not server._ext_crashed[xa]
+            # B now receives the queued pings and acks; joint stepping
+            # continues with both cores alive
+            for _ in range(3):
+                step_session(sb, 1.0, me=xb)
+                step_session(sa, 1.0, me=xa)
+            assert not server._ext_crashed[xa]
+            assert not server._ext_crashed[xb]
+            bp.write_frame(sa, bp.Frame(bp.BYE))
             bp.write_frame(sb, bp.Frame(bp.BYE))
         finally:
             sa.close()

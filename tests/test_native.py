@@ -82,6 +82,8 @@ class TestCodecParity:
             wire = pycodec.encode(msg)
             assert ncodec.decode(wire) == msg       # native reads python
             assert pycodec.decode(ncodec.encode(msg)) == msg  # and back
+            # the header-only peek agrees with the full parse
+            assert pycodec.peek_kind(wire) == msg.kind
 
     def test_maximum_size_message_parity(self):
         """255 updates × 255-byte hosts ≈ 70 KiB — the wire format's true

@@ -329,6 +329,62 @@ class TestStalledSession:
             server.join(timeout=30)
 
 
+class TestSendSpamStallsOut:
+    def test_send_spamming_session_still_stalls_out(self):
+        """Only STEP frames are liveness evidence for the barrier: a
+        wedged client whose network loop still emits SENDs (but never
+        STEPs) must stall out after stall_timeout — otherwise it would
+        freeze engine time for every other session forever — and its
+        ids are then crash-gated via the engine-time ack lag (organic
+        suspicion→confirmation after the gate is TestStalledSession's
+        coverage; the machinery is identical)."""
+        import socket
+
+        from swim_tpu.bridge import protocol as bp
+
+        n = 512
+        xa, xb = 100, 200
+        cfg = SwimConfig(n_nodes=n, **GEOM)
+        server = EngineBridgeServer(cfg, external_ids=[xa, xb], seed=13,
+                                    ack_grace=2, stall_timeout=1.5)
+        server.start()
+        sa = socket.create_connection(server.address)
+        sb = socket.create_connection(server.address)
+        try:
+            bp.write_frame(sa, bp.Frame(bp.HELLO, a=xa))
+            assert bp.read_frame(sa).op == bp.WELCOME
+            bp.write_frame(sb, bp.Frame(bp.HELLO, a=xb))
+            assert bp.read_frame(sb).op == bp.WELCOME
+            for _ in range(2):
+                step_session(sa, 1.0, me=xa)
+                step_session(sb, 1.0, me=xb)
+            t_joint = server.t
+            # A stops STEPping but keeps spamming valid SEND frames
+            # (pings at an engine node) while B steps and wall time
+            # passes the stall_timeout
+            junk = codec.encode(codec.Message(
+                kind=MsgKind.PING, sender=xa, probe_seq=1, gossip=()))
+            deadline = time.monotonic() + 6.0
+            while time.monotonic() < deadline and not server._ext_crashed[xa]:
+                bp.write_frame(sa, bp.Frame(bp.SEND, a=xa, b=7,
+                                            payload=junk))
+                step_session(sb, 1.0, me=xb)
+                time.sleep(0.1)
+            assert server.t > t_joint, (
+                "engine time stayed frozen behind the SEND-spamming "
+                "session")
+            assert server._ext_crashed[xa], (
+                "SEND spam kept the non-STEPping session gating — it "
+                "was never crash-gated")
+            assert not server._ext_crashed[xb]
+            bp.write_frame(sb, bp.Frame(bp.BYE))
+        finally:
+            sa.close()
+            sb.close()
+            server.close()
+            server.join(timeout=30)
+
+
 class TestCatchUpBurst:
     def test_lagging_session_burst_does_not_crash_gate_the_other(self):
         """When session A lags and then catches up in one STEP, the

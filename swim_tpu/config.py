@@ -90,6 +90,13 @@ class SwimConfig:
     #                              Pull mode always selects once before
     #                              any delivery; the knob is a no-op
     #                              there.
+    ring_cold_kernel: str = "auto"  # cold-ring flush + view-query path
+    #                              (rotor only): "auto" uses the fused
+    #                              Pallas kernel (ops/coldsel.py) on the
+    #                              TPU backend and the jnp lowering
+    #                              elsewhere; "pallas"/"lax" force one
+    #                              path (pallas runs interpreted off-TPU
+    #                              — tests pin the two bitwise-equal).
 
     def __post_init__(self):
         if self.n_nodes < 2:
@@ -100,6 +107,16 @@ class SwimConfig:
             raise ValueError(f"bad ring_probe {self.ring_probe!r}")
         if self.ring_sel_scope not in ("wave", "period"):
             raise ValueError(f"bad ring_sel_scope {self.ring_sel_scope!r}")
+        if self.ring_cold_kernel not in ("auto", "pallas", "lax"):
+            raise ValueError(
+                f"bad ring_cold_kernel {self.ring_cold_kernel!r}")
+        if self.ring_cold_kernel == "pallas" and self.ring_probe != "rotor":
+            raise ValueError(
+                "ring_cold_kernel='pallas' requires ring_probe='rotor': "
+                "the pull branch reads cold through gather-style knows_* "
+                "lookups before the fused flush+select pass could run — "
+                "a forced-pallas pull run would silently use the gather "
+                "path (use 'auto' or 'lax' with pull)")
         if self.ring_probe == "pull" and self.lifeguard:
             raise ValueError(
                 "ring_probe='pull' supports the vanilla protocol only: "

@@ -106,7 +106,7 @@ import jax
 import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
-from swim_tpu.ops import lattice, sampling
+from swim_tpu.ops import coldsel, lattice, sampling
 from swim_tpu.sim.faults import FaultPlan
 
 WORD = 32
@@ -414,26 +414,6 @@ def _col_select_multi(mat: jax.Array, cols: list[jax.Array]) -> list[jax.Array]:
                           lambda a, b: tuple(
                               jnp.maximum(x, y) for x, y in zip(a, b)),
                           (1,))
-    return list(outs)
-
-
-def _row_select_multi(mat: jax.Array, rows: list[jax.Array]) -> list[jax.Array]:
-    """[mat[r[i], i] for r in rows] over a WORD-major [W, N] matrix —
-    the `cold` twin of _col_select_multi (same one-hot-reduce shape;
-    same rationale: a slice per word is a strided tile walk, a fused
-    masked reduce is one full-bandwidth pass per query).  Same
-    unsigned/non-negative-dtype contract: max-reduce against a 0 fill,
-    so negative values would be masked to 0."""
-    w_ids = jnp.arange(mat.shape[0], dtype=jnp.int32)
-    zero = jnp.zeros((), mat.dtype)
-    # same single-pass variadic reduce as _col_select_multi (see its
-    # traffic note), reducing the word-major axis 0
-    ops_in = [jnp.where(jnp.asarray(r)[None, :] == w_ids[:, None],
-                        mat, zero) for r in rows]
-    outs = jax.lax.reduce(ops_in, [zero] * len(rows),
-                          lambda a, b: tuple(
-                              jnp.maximum(x, y) for x, y in zip(a, b)),
-                          (0,))
     return list(outs)
 
 
@@ -803,10 +783,26 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # single-row update of the [RW, N] matrix is a strided read-modify-
     # write of every tile (measured ~7 ms each at 1M), while the fused
     # where-pass streams cold once at HBM bandwidth.
-    row_ids = jnp.arange(g.rw, dtype=jnp.int32)[:, None]       # [RW, 1]
-    for w in range(g.ow):
-        cold = jnp.where(row_ids == jnp.mod(entry_gw0 + w, g.rw),
-                         state.win[:, w][None, :], cold)
+    # In rotor mode the flush is DEFERRED into the Phase-C fused pass
+    # (ops/coldsel.py — the single home of the flush+select lowering):
+    # nothing between here and the view queries reads cold, and fusing
+    # flush + Q-query select into one blocked Pallas kernel reads and
+    # writes cold exactly once per period on the TPU backend (it also
+    # removes the {0,1}/{1,0} layout copies XLA otherwise inserts
+    # around the loop carry — round-4 TPU HLO attribution).  The pull
+    # branch reads cold through gather-style knows_* lookups before
+    # Phase C, so it flushes here, immediately.
+    flush_rows = jnp.stack(
+        [jnp.mod(entry_gw0 + w, g.rw) for w in range(g.ow)]
+    ).astype(jnp.int32)                                        # i32[OW]
+    defer_flush = cfg.ring_probe == "rotor"
+    if defer_flush:
+        flush_vals = state.win[:, :g.ow].T                     # u32[OW, N]
+    else:
+        row_ids = jnp.arange(g.rw, dtype=jnp.int32)[:, None]   # [RW, 1]
+        for w in range(g.ow):
+            cold = jnp.where(row_ids == flush_rows[w],
+                             state.win[:, w][None, :], cold)
     fresh_cols = out_cols & carry_mask[None, :]                # u32[N, OW]
     win = jnp.concatenate([state.win[:, g.ow:], fresh_cols], axis=1)
     first_gw = entry_gw0 + g.ow        # win col 0's global word, post-shift
@@ -988,7 +984,15 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         q_slots.append(sus_slot)               # self query: subj == ids
         q_pos = [slot_pos(s) for s in q_slots]
         q_win = _col_select_multi(win, [p[1] for p in q_pos])
-        q_cold = _row_select_multi(cold, [p[2] for p in q_pos])
+        # Fused deferred-flush + select: cold becomes post-flush here,
+        # exactly as an immediate Phase-0d where-pass would have left
+        # it (bitwise contract: tests/test_coldsel.py pins the pallas
+        # and jnp lowerings equal element-for-element).
+        cold, q_cold_arr = coldsel.cold_update_select(
+            cold, flush_rows, flush_vals,
+            jnp.stack([p[2] for p in q_pos]),
+            impl=cfg.ring_cold_kernel)
+        q_cold = [q_cold_arr[i] for i in range(len(q_pos))]
         q_kn = []
         for (ok, _, _, bit), wv, cv, s in zip(q_pos, q_win, q_cold,
                                               q_slots):

@@ -1,0 +1,175 @@
+"""Fused cold-ring update + multi-query select (TPU Pallas kernel).
+
+Why this kernel exists (round-4 TPU attribution, docs/RESULTS.md §1):
+the XLA-lowered hot path paid ~3.5 GB/period of avoidable HBM traffic
+on the 512 MB cold matrix at the 1M-node flagship geometry —
+
+  * two full-matrix layout copies per period: XLA's layout assignment
+    gave the loop-carried `cold` buffer a `{0,1}` (node-major) layout
+    to suit the eq-iota one-hot selects, while the Phase-0b row slices
+    and the flush want `{1,0}` (node-minor), so every period round-
+    tripped 512 MB through `copy` instructions in both directions;
+  * the Q-query one-hot `lax.reduce` decomposed into Q separate
+    full-matrix fusions on the TPU backend (measured as three extra
+    512 MB `gather` fusions), although the CPU backend fuses them
+    into one pass — the round-4 CPU cost proxy halved while the TPU
+    wall time stayed flat.
+
+This kernel replaces the Phase-0d flush (OW row overwrites) and the
+Phase-C view-query selects (Q per-node row lookups) with ONE blocked
+pass: cold is read once and written once per period, all Q selects are
+computed from the in-VMEM block, and — because Mosaic kernels use the
+default `{1,0}` layout — every remaining XLA consumer (the contiguous
+Phase-0b row slices) agrees with the carry layout, so the copies
+disappear.
+
+Semantics (bitwise-exact twin of the jnp path, pinned by
+tests/test_coldsel.py):
+
+    new_cold = cold with row flush_rows[w] := flush_vals[w]  (w < OW)
+    sel[q][i] = new_cold[q_rows[q, i], i]  if 0 <= q_rows[q, i] < RW
+                else 0
+
+Everything is lane-local (each node column i depends only on column i
+of the inputs plus the shared scalars), which makes the kernel safe
+under the sharded engine (per-shard local columns) and value-identical
+under interpret mode's clamped ragged-edge re-execution.
+
+The reference tree is unavailable (see SURVEY.md §0); the protocol
+semantics this implements are the window→cold-ring flush and heard-bit
+view queries documented at models/ring.py Phase 0d / Phase C.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _block_n(rw: int, n: int) -> int:
+    """Node-axis block width (lanes), sized to the ring depth.
+
+    The double-buffered [RW, BN] in + out blocks dominate VMEM:
+    roughly 2 (in+out) * 2 (double buffer) * RW * BN * 4 bytes.  A
+    fixed BN=2048 fits the RW=128 flagship geometry with room to spare
+    but overflowed the 16 MB scoped-vmem limit at the Lifeguard
+    geometry's RW=512 (observed: 16.06M > 16.00M).  Budget ~10 MB for
+    the big blocks and round down to the 128-lane tile."""
+    bn = (10 * 1024 * 1024) // (16 * rw)
+    bn = max(128, min(2048, (bn // 128) * 128))
+    return min(bn, max(128, n))
+
+
+def _kernel(fr_ref, cold_ref, fv_ref, qr_ref, new_ref, sel_ref):
+    """One node-axis block: flush OW rows, then Q one-hot row selects.
+
+    fr_ref:  SMEM i32[OW]   ring rows to overwrite (scalar prefetch)
+    cold_ref: VMEM u32[RW, BN]
+    fv_ref:  VMEM u32[OW, BN]  replacement row contents
+    qr_ref:  VMEM i32[Q, BN]   per-lane query rows
+    new_ref: VMEM u32[RW, BN]  flushed block out
+    sel_ref: VMEM u32[Q, BN]   selected words out
+    """
+    ow = fv_ref.shape[0]
+    q_n = qr_ref.shape[0]
+    blk = cold_ref[...]
+    riota = jax.lax.broadcasted_iota(jnp.int32, blk.shape, 0)
+    for w in range(ow):
+        blk = jnp.where(riota == fr_ref[w], fv_ref[w:w + 1, :], blk)
+    new_ref[...] = blk
+    # Mosaic has no unsigned reductions; the select is ONE-HOT (riota
+    # matches at most one row per lane), so a bitcast-i32 SUM of the
+    # masked block is bit-exact: zero addends plus at most one payload.
+    blk_i = jax.lax.bitcast_convert_type(blk, jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    for q in range(q_n):
+        hit = riota == qr_ref[q:q + 1, :]
+        picked = jnp.sum(jnp.where(hit, blk_i, zero), axis=0,
+                         keepdims=True)
+        sel_ref[q:q + 1, :] = jax.lax.bitcast_convert_type(
+            picked, jnp.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _call(flush_rows, cold, flush_vals, q_rows, *, interpret):
+    rw, n = cold.shape
+    ow = flush_vals.shape[0]
+    q_n = q_rows.shape[0]
+    bn = _block_n(rw, n)
+    grid = (_cdiv(n, bn),)
+    new_cold, sel = pl.pallas_call(
+        _kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rw, bn), lambda i, fr: (0, i)),
+                pl.BlockSpec((ow, bn), lambda i, fr: (0, i)),
+                pl.BlockSpec((q_n, bn), lambda i, fr: (0, i)),
+            ],
+            out_specs=[
+                pl.BlockSpec((rw, bn), lambda i, fr: (0, i)),
+                pl.BlockSpec((q_n, bn), lambda i, fr: (0, i)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((rw, n), jnp.uint32),
+            jax.ShapeDtypeStruct((q_n, n), jnp.uint32),
+        ],
+        # new_cold reuses cold's buffer: each grid block is fully DMA'd
+        # to VMEM before its output DMA starts, so in-place is safe, and
+        # the alias lets XLA update the loop-carried buffer without the
+        # defensive 512 MB copy it otherwise inserts per period.
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(flush_rows, cold, flush_vals, q_rows)
+    return new_cold, sel
+
+
+def _lax_twin(flush_rows, cold, flush_vals, q_rows):
+    """jnp reference implementation — the pre-kernel lowering, kept as
+    the non-TPU path and the bitwise contract for the kernel tests."""
+    rw = cold.shape[0]
+    row_ids = jnp.arange(rw, dtype=jnp.int32)[:, None]
+    new = cold
+    for w in range(flush_vals.shape[0]):
+        new = jnp.where(row_ids == flush_rows[w], flush_vals[w][None, :],
+                        new)
+    zero = jnp.zeros((), cold.dtype)
+    ops_in = [jnp.where(row_ids == q_rows[q][None, :], new, zero)
+              for q in range(q_rows.shape[0])]
+    outs = jax.lax.reduce(ops_in, [zero] * len(ops_in),
+                          lambda a, b: tuple(
+                              jnp.maximum(x, y) for x, y in zip(a, b)),
+                          (0,))
+    return new, jnp.stack(list(outs))
+
+
+def cold_update_select(cold, flush_rows, flush_vals, q_rows,
+                       impl: str = "auto"):
+    """Flush OW rows into the cold ring and answer Q row queries.
+
+    cold:       u32[RW, N]
+    flush_rows: i32[OW]     ring rows to overwrite (traced scalars ok)
+    flush_vals: u32[OW, N]  replacement contents (the outgoing window
+                            columns, word-major)
+    q_rows:     i32[Q, N]   per-node query rows; out-of-[0, RW) -> 0
+    impl:       "auto" (pallas on the TPU backend, jnp elsewhere),
+                "pallas" (interpret mode off-TPU), or "lax"
+
+    Returns (new_cold u32[RW, N], sel u32[Q, N]).
+    """
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(f"bad impl {impl!r}: want auto|pallas|lax")
+    if impl == "lax" or (impl == "auto"
+                         and jax.default_backend() != "tpu"):
+        return _lax_twin(flush_rows, cold, flush_vals, q_rows)
+    interpret = jax.default_backend() != "tpu"
+    return _call(flush_rows.astype(jnp.int32), cold, flush_vals,
+                 q_rows.astype(jnp.int32), interpret=interpret)

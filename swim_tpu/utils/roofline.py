@@ -55,15 +55,17 @@ def ring_traffic(cfg: SwimConfig) -> dict[str, Any]:
     waves = 2 + 4 * k                     # W1..W2 + k×(W3..W6)
     terms: dict[str, tuple[float, float]] = {}
 
-    # Phase 0: window shift (read+write win); the cold flush is a fused
-    # full-matrix where-pass (read+write cold — a row-granular update
-    # cannot lower to anything cheaper without strided tile walks, see
-    # ring.py Phase 0d); the invalidation census streams cold once more
-    # (_row_select_multi) plus the lane-count reduce; the outgoing-column
-    # census reads win[:, :OW].
+    # Phase 0: window shift (read+write win); the invalidation census
+    # reads OW contiguous cold rows (word-major row slices, ~nvec each)
+    # plus the lane-count reduce; the outgoing-column census reads
+    # win[:, :OW].  In rotor mode the cold FLUSH is deferred into the
+    # fused Phase-C kernel pass (ops/coldsel.py) and accounted there;
+    # in pull mode it is a full-matrix where-pass here (read+write).
+    rotor = cfg.ring_probe == "rotor"
+    flush_here = 0.0 if rotor else 2 * cold
     terms["phase0_shift_flush"] = (
-        2 * win + 3 * cold + 3 * g.ow * nvec,
-        2 * win + (2 + 2 * g.ow) * cold + 4 * g.ow * nvec)
+        2 * win + flush_here + 3 * g.ow * nvec,
+        2 * win + flush_here + (2 * g.ow) * nvec + 4 * g.ow * nvec)
 
     # Top-C per-subject index: C rounds of scatter_max/gather pairs over
     # node vectors (bk, bs) — ~4 nvec passes per round fused.
@@ -96,11 +98,21 @@ def ring_traffic(cfg: SwimConfig) -> dict[str, Any]:
     buddy = (1 + k) if (cfg.lifeguard and cfg.buddy) else 0
     terms["buddy_bits"] = (buddy * win, buddy * 2 * win)
 
-    # Fused view/self query: one streamed pass over win (column-select)
-    # and one over cold serving all C+1 queries when XLA shares the
-    # broadcast read (fused bracket); per-query cold reads otherwise.
-    terms["query_pass"] = (win + cold,
-                           win + (g.c + 1) * cold + (g.c + 1) * 2 * nvec)
+    # View/self query pass.  Rotor: the fused coldsel kernel streams
+    # cold once (read) and writes the flushed matrix once, answering
+    # all C+1 queries from the in-VMEM block, plus one win column-
+    # select pass (fused bracket); the unfused bracket is the jnp
+    # lowering's per-query cold reads plus a separate flush.  Pull:
+    # the flush was paid in Phase 0, queries are gather-based (charged
+    # one cold-pass equivalent fused).
+    if rotor:
+        terms["query_pass"] = (win + 2 * cold,
+                               win + 2 * cold + (g.c + 1) * cold
+                               + (g.c + 1) * 2 * nvec)
+    else:
+        terms["query_pass"] = (win + cold,
+                               win + (g.c + 1) * cold
+                               + (g.c + 1) * 2 * nvec)
 
     # Phase C/D: suspicion vectors, first-true top_k compactions,
     # origination scatters — all nvec-scale (~12 passes fused).

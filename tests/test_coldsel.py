@@ -1,0 +1,95 @@
+"""Bitwise contract for the fused cold-ring kernel (ops/coldsel.py).
+
+Two layers, mirroring the engine's other kernel contracts:
+
+  1. Op-level: the Pallas kernel (interpret mode on the CPU mesh) must
+     be element-for-element equal to the jnp twin for random inputs,
+     including out-of-range query rows and ragged node counts that do
+     not divide the kernel's block width.
+  2. Engine-level: a multi-period ring run with ring_cold_kernel=
+     "pallas" must leave bitwise-identical state to "lax" — the same
+     contract the sharded engine and the scalar oracle are held to.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from swim_tpu import SwimConfig
+from swim_tpu.models import ring
+from swim_tpu.ops.coldsel import cold_update_select
+from swim_tpu.sim import faults
+
+
+@pytest.mark.parametrize(
+    "rw,n,ow,q",
+    [
+        (128, 5000, 2, 4),   # flagship geometry shape, ragged n
+        (16, 300, 1, 3),     # small ring, ragged n
+        (8, 128, 2, 1),      # exact block divisor
+        (34, 4096, 2, 4),    # rw not a sublane multiple
+    ],
+)
+def test_kernel_matches_lax_twin(rw, n, ow, q):
+    rng = np.random.default_rng(rw * n + ow + q)
+    cold = jnp.asarray(rng.integers(0, 2**32, (rw, n), dtype=np.uint32))
+    fr = jnp.asarray(rng.integers(0, rw, (ow,), dtype=np.int32))
+    fv = jnp.asarray(rng.integers(0, 2**32, (ow, n), dtype=np.uint32))
+    # queries include out-of-range rows on both sides (contract: -> 0)
+    qr = jnp.asarray(rng.integers(-2, rw + 2, (q, n), dtype=np.int32))
+    nc_l, s_l = cold_update_select(cold, fr, fv, qr, impl="lax")
+    nc_p, s_p = cold_update_select(cold, fr, fv, qr, impl="pallas")
+    np.testing.assert_array_equal(np.asarray(nc_l), np.asarray(nc_p))
+    np.testing.assert_array_equal(np.asarray(s_l), np.asarray(s_p))
+
+
+def test_kernel_top_bit_exact():
+    """Payloads with bit 31 set survive the kernel's bitcast-i32
+    one-hot sum unchanged (the reason the reduce is a sum, not an
+    unsigned max, is a Mosaic limitation — the value path must stay
+    exact for full-range u32 words)."""
+    rw, n = 8, 256
+    cold = jnp.full((rw, n), 0xFFFFFFFF, jnp.uint32)
+    fr = jnp.asarray([3], dtype=np.int32)
+    fv = jnp.full((1, n), 0x80000001, jnp.uint32)
+    qr = jnp.asarray(
+        np.stack([np.full(n, 3), np.full(n, 5)]).astype(np.int32))
+    nc, sel = cold_update_select(cold, fr, fv, qr, impl="pallas")
+    assert np.asarray(nc)[3].tolist() == [0x80000001] * n
+    assert np.asarray(sel)[0].tolist() == [0x80000001] * n
+    assert np.asarray(sel)[1].tolist() == [0xFFFFFFFF] * n
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "periods"))
+def _run(cfg, st, plan, periods):
+    key = jax.random.key(0)
+
+    def body(s, _):
+        rnd = ring.draw_period_ring(jax.random.fold_in(key, 7), s.step,
+                                    cfg)
+        return ring.step(cfg, s, plan, rnd), None
+
+    s, _ = jax.lax.scan(body, st, None, length=periods)
+    return s
+
+
+@pytest.mark.parametrize("scope", ["wave", "period"])
+@pytest.mark.parametrize("lifeguard", [False, True])
+def test_engine_state_bitwise_equal(scope, lifeguard):
+    n, periods = 256, 8
+    states = {}
+    for impl in ("lax", "pallas"):
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope=scope,
+                         lifeguard=lifeguard, ring_cold_kernel=impl)
+        st = ring.init_state(cfg)
+        plan = faults.with_random_crashes(
+            faults.none(n), jax.random.key(1), 0.02, 0, periods)
+        plan = faults.with_loss(plan, 0.05)
+        states[impl] = _run(cfg, st, plan, periods)
+    for field, a, b in zip(states["lax"]._fields, states["lax"],
+                           states["pallas"]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=field)

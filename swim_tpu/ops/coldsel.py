@@ -60,9 +60,15 @@ def _block_n(rw: int, n: int) -> int:
     fixed BN=2048 fits the RW=128 flagship geometry with room to spare
     but overflowed the 16 MB scoped-vmem limit at the Lifeguard
     geometry's RW=512 (observed: 16.06M > 16.00M).  Budget ~10 MB for
-    the big blocks and round down to the 128-lane tile."""
-    bn = (10 * 1024 * 1024) // (16 * rw)
-    bn = max(128, min(2048, (bn // 128) * 128))
+    the big blocks and round down to the 128-lane tile.
+
+    Returns 0 when even ONE 128-lane tile would overflow the budget
+    (rw > 5120): flooring at 128 regardless would reintroduce exactly
+    the scoped-vmem compile failure this sizing exists to prevent, so
+    callers must fall back to the jnp lowering instead."""
+    bn = min(2048, ((10 * 1024 * 1024) // (16 * rw) // 128) * 128)
+    if bn == 0:
+        return 0
     return min(bn, max(128, n))
 
 
@@ -169,6 +175,15 @@ def cold_update_select(cold, flush_rows, flush_vals, q_rows,
         raise ValueError(f"bad impl {impl!r}: want auto|pallas|lax")
     if impl == "lax" or (impl == "auto"
                          and jax.default_backend() != "tpu"):
+        return _lax_twin(flush_rows, cold, flush_vals, q_rows)
+    if _block_n(cold.shape[0], cold.shape[1]) == 0:
+        # Ring deeper than the kernel's VMEM budget can block (RW >
+        # 5120, e.g. a very large ring_orig_words * suspicion life).
+        if impl == "pallas":
+            raise ValueError(
+                f"ring depth RW={cold.shape[0]} exceeds the Pallas "
+                "cold kernel's scoped-vmem budget (max 5120 words); "
+                "use ring_cold_kernel='auto' or 'lax'")
         return _lax_twin(flush_rows, cold, flush_vals, q_rows)
     interpret = jax.default_backend() != "tpu"
     return _call(flush_rows.astype(jnp.int32), cold, flush_vals,

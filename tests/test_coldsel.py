@@ -63,6 +63,31 @@ def test_kernel_top_bit_exact():
     assert np.asarray(sel)[1].tolist() == [0xFFFFFFFF] * n
 
 
+def test_deep_ring_falls_back_to_lax():
+    """RW beyond the kernel's VMEM budget (no 128-lane tile fits,
+    RW > 5120) must not reach pallas_call: 'auto' silently takes the
+    jnp lowering (same values), forced 'pallas' raises a geometry
+    error instead of a Mosaic scoped-vmem compile failure."""
+    from swim_tpu.ops import coldsel
+
+    rw, n = 5248, 256        # 16 * 5248 * 128 = 10.25 MB > the budget
+    assert coldsel._block_n(rw, n) == 0
+    rng = np.random.default_rng(7)
+    cold = jnp.asarray(rng.integers(0, 2**32, (rw, n), dtype=np.uint32))
+    fr = jnp.asarray([1], dtype=np.int32)
+    fv = jnp.asarray(rng.integers(0, 2**32, (1, n), dtype=np.uint32))
+    qr = jnp.asarray(rng.integers(-2, rw + 2, (2, n), dtype=np.int32))
+    want_nc, want_sel = cold_update_select(cold, fr, fv, qr, impl="lax")
+    got_nc, got_sel = cold_update_select(cold, fr, fv, qr, impl="auto")
+    np.testing.assert_array_equal(np.asarray(want_nc), np.asarray(got_nc))
+    np.testing.assert_array_equal(np.asarray(want_sel),
+                                  np.asarray(got_sel))
+    with pytest.raises(ValueError, match="scoped-vmem budget"):
+        cold_update_select(cold, fr, fv, qr, impl="pallas")
+    # the boundary depth still blocks: one 128-lane tile exactly fits
+    assert coldsel._block_n(5120, n) == 128
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "periods"))
 def _run(cfg, st, plan, periods):
     key = jax.random.key(0)

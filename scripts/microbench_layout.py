@@ -67,7 +67,9 @@ def timeit(name, fn, *args):
 
 
 def select_nm(win, elig):                    # node-major [N, WW]
-    return _select_first_b(win & elig[None, :], B)
+    # impl="lax" pins the XLA extract loop: this script A/Bs LAYOUTS,
+    # and "auto" would silently measure the Pallas selb kernel on TPU
+    return _select_first_b(win & elig[None, :], B, impl="lax")
 
 
 def select_wm(win, elig):                    # word-major [WW, N]

@@ -90,6 +90,14 @@ class SwimConfig:
     #                              Pull mode always selects once before
     #                              any delivery; the knob is a no-op
     #                              there.
+    ring_selb_kernel: str = "auto"  # first-B piggyback selection path:
+    #                              "auto" uses the fused one-pass
+    #                              Pallas kernel (ops/selb.py) on the
+    #                              TPU backend and the budgeted
+    #                              extract loop elsewhere; "pallas"/
+    #                              "lax" force one (pallas runs
+    #                              interpreted off-TPU; tests pin the
+    #                              two bitwise-equal).
     ring_cold_kernel: str = "auto"  # cold-ring flush + view-query path
     #                              (rotor only): "auto" uses the fused
     #                              Pallas kernel (ops/coldsel.py) on the
@@ -110,6 +118,9 @@ class SwimConfig:
         if self.ring_cold_kernel not in ("auto", "pallas", "lax"):
             raise ValueError(
                 f"bad ring_cold_kernel {self.ring_cold_kernel!r}")
+        if self.ring_selb_kernel not in ("auto", "pallas", "lax"):
+            raise ValueError(
+                f"bad ring_selb_kernel {self.ring_selb_kernel!r}")
         if self.ring_cold_kernel == "pallas" and self.ring_probe != "rotor":
             raise ValueError(
                 "ring_cold_kernel='pallas' requires ring_probe='rotor': "

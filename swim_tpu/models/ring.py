@@ -106,7 +106,7 @@ import jax
 import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
-from swim_tpu.ops import coldsel, lattice, sampling
+from swim_tpu.ops import coldsel, lattice, sampling, selb
 from swim_tpu.sim.faults import FaultPlan
 
 WORD = 32
@@ -348,38 +348,47 @@ def draw_period_ring(key: jax.Array, step, cfg: SwimConfig) -> RingRandomness:
                 ack_u=jax.random.uniform(ks[7], (n,)),
                 ack_leg=jax.random.uniform(ks[8], (n,)),
             ))
-    ks = jax.random.split(kk, 7)
+    # The seven rotor uniforms exist only to be threshold-compared
+    # (Bernoulli loss legs, LHA probe thinning), so 16-bit resolution
+    # is ample (quantizes each probability by <= 1/65536).  Packing
+    # two u16 halves per u32 threefry output halves the generated
+    # bits: 4 [N] + 2 [N, k] raw draws instead of 3 [N] + 4 [N, k]
+    # f32 uniforms (the period RNG measured 0.67 ms at the 1M
+    # flagship — the generation, not the use, is the cost).  The
+    # oracle consumes these same tensors (ring_oracle.py), so the
+    # bitwise engine<->oracle contract is unaffected by HOW they are
+    # drawn.
+    ks = jax.random.split(kk, 4)
+    inv = jnp.float32(1.0 / 65536.0)
+
+    def halves(bits):
+        return ((bits & jnp.uint32(0xFFFF)).astype(jnp.float32) * inv,
+                (bits >> 16).astype(jnp.float32) * inv)
+
+    w12 = jax.random.bits(ks[0], (n,), jnp.uint32)
+    w34 = jax.random.bits(ks[1], (n, k), jnp.uint32)
+    w56 = jax.random.bits(ks[2], (n, k), jnp.uint32)
+    lha_b = jax.random.bits(ks[3], (n,), jnp.uint32)
+    loss_w1, loss_w2 = halves(w12)
+    loss_w3, loss_w4 = halves(w34)
+    loss_w5, loss_w6 = halves(w56)
     return RingRandomness(
         s_off=s_off.astype(jnp.int32),
         q_off=q_off.astype(jnp.int32),
-        loss_w1=jax.random.uniform(ks[0], (n,)),
-        loss_w2=jax.random.uniform(ks[1], (n,)),
-        loss_w3=jax.random.uniform(ks[2], (n, k)),
-        loss_w4=jax.random.uniform(ks[3], (n, k)),
-        loss_w5=jax.random.uniform(ks[4], (n, k)),
-        loss_w6=jax.random.uniform(ks[5], (n, k)),
-        lha_u=jax.random.uniform(ks[6], (n,)),
+        loss_w1=loss_w1, loss_w2=loss_w2,
+        loss_w3=loss_w3, loss_w4=loss_w4,
+        loss_w5=loss_w5, loss_w6=loss_w6,
+        lha_u=halves(lha_b)[0],
     )
 
 
-def _select_first_b(win_masked, b: int):
+def _select_first_b(win_masked, b: int, impl: str = "auto"):
     """u32[N, WW]: mask of the first `b` set bits of each row's window,
-    newest word first, LSB-first within a word — a fused branch-free
-    lowest-set-bit extract loop (no top_k, no unpacking)."""
-    ww = win_masked.shape[-1]
-    taken = [None] * ww
-    budget = jnp.full(win_masked.shape[:1], b, jnp.int32)
-    for w in range(ww - 1, -1, -1):         # newest word first
-        m = win_masked[:, w]
-        acc = jnp.zeros_like(m)
-        for _ in range(min(b, WORD)):
-            low = m & (jnp.uint32(0) - m)   # lowest set bit (0 if none)
-            bitm = jnp.where(budget > 0, low, jnp.uint32(0))
-            acc = acc | bitm
-            m = m ^ bitm
-            budget = budget - (bitm != 0).astype(jnp.int32)
-        taken[w] = acc
-    return jnp.stack(taken, axis=-1)
+    newest word first, LSB-first within a word.  Lowering lives in
+    ops/selb.py (Pallas one-pass kernel on TPU, budgeted extract loop
+    elsewhere; bitwise-pinned by
+    tests/test_core_units.py::TestSelectFirstB)."""
+    return selb.select_first_b(win_masked, b, impl=impl)
 
 
 def _col_select_multi(mat: jax.Array, cols: list[jax.Array]) -> list[jax.Array]:
@@ -875,12 +884,14 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     period_scope = cfg.ring_sel_scope == "period"
     sel_src = win                      # start-of-period window snapshot
     if period_scope:
-        sel_base = _select_first_b(sel_src & elig_mask[None, :], b_pig)
+        sel_base = _select_first_b(sel_src & elig_mask[None, :], b_pig,
+                                   impl=cfg.ring_selb_kernel)
 
     def sel_now(forced):
         if period_scope:
             return sel_base | forced
-        return _select_first_b(win & elig_mask[None, :], b_pig) | forced
+        return _select_first_b(win & elig_mask[None, :], b_pig,
+                               impl=cfg.ring_selb_kernel) | forced
 
     def sel_win():
         """The window senders consult for piggyback/buddy knowledge."""
@@ -1179,13 +1190,24 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     gone_at_r = ops.gather(gone_key, subj_r)
     higher_known = jnp.broadcast_to((gone_at_r > rkey)[:, None],
                                     snode.shape)
+    # All C levels' heard-bit probes ride ONE knows_bit call: per-level
+    # calls cost two 16k-element generic gathers EACH (win row + cold
+    # column), and TPU executes those near-serially — the round-4
+    # profile measured the 6 separate gathers at ~1.5 ms/period @ 1M.
+    # Batched [R, S*C] they are two gathers total, same element count.
+    snode_cl = jnp.maximum(snode, 0)
+    oslots, cands = [], []
     for lvl in range(g.c):
         oslot = ops.gather(top_slot[lvl], subj_r)              # [R]
         okey = ops.gather(top_key[lvl], subj_r)
-        cand = ((okey > rkey) & (oslot >= 0))[:, None]
-        kn = knows_bit(jnp.maximum(snode, 0),
-                       jnp.broadcast_to(oslot[:, None], snode.shape))
-        higher_known = higher_known | (cand & kn)
+        cands.append(((okey > rkey) & (oslot >= 0))[:, None])
+        oslots.append(jnp.broadcast_to(oslot[:, None], snode.shape))
+    kn_b = knows_bit(jnp.concatenate([snode_cl] * g.c, axis=1),
+                     jnp.concatenate(oslots, axis=1))
+    s_lanes = snode.shape[1]
+    for lvl in range(g.c):
+        kn = kn_b[:, lvl * s_lanes:(lvl + 1) * s_lanes]
+        higher_known = higher_known | (cands[lvl] & kn)
     can_confirm = deadline_hit & ~higher_known
     dead_key_r = lattice.dead_key(lattice.incarnation_of(rkey))
     confirm = (used & is_susp_r & ~confirmed

@@ -69,7 +69,9 @@ def _block_n(rw: int, n: int) -> int:
     bn = min(2048, ((10 * 1024 * 1024) // (16 * rw) // 128) * 128)
     if bn == 0:
         return 0
-    return min(bn, max(128, n))
+    # round small n UP to the 128-lane tile (grid padding masks the
+    # overhang); min(bn, n) could otherwise emit an unaligned block
+    return min(bn, max(128, _cdiv(n, 128) * 128))
 
 
 def _kernel(fr_ref, cold_ref, fv_ref, qr_ref, new_ref, sel_ref):

@@ -1,0 +1,160 @@
+"""Fused first-B piggyback selection (TPU Pallas kernel).
+
+`_select_first_b` — the mask of the first `b` set bits of each node's
+window, newest word first, LSB-first within a word — is the piggyback
+payload selection at the top of every rotor period (and of every WAVE
+in exact wave-scope mode).  Its natural formulation is a budgeted
+lowest-set-bit extract loop, which carries the per-node budget
+SERIALLY through WW x min(b, 32) iterations; XLA lowers that ~72-deep
+dependency chain into ~10 separate [N]-vector fusions (measured
+1.31 ms/period at the 1M flagship geometry, the third-largest term in
+the round-4 TPU profile).  A jnp popcount/prefix rewrite was tried
+first and measured SLOWER in the full program (the [:, ::-1] suffix
+flips materialized as two full-matrix `rev` copies and the cumsum as a
+reduce-window: 81.7 -> 67 periods/sec end-to-end) — the closed form
+only pays off when the whole computation stays in registers, i.e. in a
+kernel.
+
+This kernel computes the same mask in ONE streamed pass over the
+window (read [WW, N] once, write [WW, N] once):
+
+  * popcount each word, exclusive suffix-sum across words (newest
+    first) in VMEM registers -> per-word remaining budget;
+  * "lowest budget set bits of m" == m & lowmask(t) for the largest
+    t in [0, 32] with popcount(m & lowmask(t)) <= budget, found by a
+    6-step branch-free binary ascent (32, 16, .., 1), independent per
+    word (the budget math above removed the cross-word serialization).
+
+Everything is lane-local (node columns are independent), so the kernel
+is safe under the sharded engine and value-identical in interpret
+mode.  Bitwise contract: tests/test_core_units.py::TestSelectFirstB
+pins kernel and twin element-for-element against an independent numpy
+reference of the extract loop.
+
+The reference tree is unavailable (see SURVEY.md §0); protocol
+semantics follow the bounded piggyback selection documented at
+models/ring.py and docs/PROTOCOL.md (fewest-transmits-first analog).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+WORD = 32
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _block_n(ww: int, n: int) -> int:
+    """Lane-block width: in+out double-buffered [WW, BN] u32 blocks,
+    ~10 MB budget, 128-lane tiles.  0 => no tile fits (fall back)."""
+    bn = min(2048, ((10 * 1024 * 1024) // (16 * ww) // 128) * 128)
+    if bn == 0:
+        return 0
+    # round small n UP to the 128-lane tile (grid padding masks the
+    # overhang); min(bn, n) could otherwise emit an unaligned block
+    return min(bn, max(128, _cdiv(n, 128) * 128))
+
+
+def _lowmask(t):
+    """u32 mask of bit positions [0, t) for t in [0, 32] (branch-free;
+    the t==32 shift is discarded by the where)."""
+    full = jnp.uint32(0xFFFFFFFF)
+    return jnp.where(t >= WORD, full,
+                     (jnp.uint32(1) << t.astype(jnp.uint32))
+                     - jnp.uint32(1))
+
+
+def _first_b_math(m, b: int):
+    """The popcount/suffix/binary-ascent form on a [WW, BN] block
+    (axis 0 = words, newest LAST — same order as the window layout).
+    Shared verbatim by the kernel body and nothing else: the jnp twin
+    deliberately keeps the extract-loop form (see module docstring)."""
+    ww = m.shape[0]
+    pc = jax.lax.population_count(
+        jax.lax.bitcast_convert_type(m, jnp.int32))
+    # exclusive suffix sums, newest word (last row) first
+    excl_rows = []
+    acc = jnp.zeros_like(pc[0:1])
+    for w in range(ww - 1, -1, -1):
+        excl_rows.append(acc)
+        acc = acc + pc[w:w + 1]
+    excl = jnp.concatenate(excl_rows[::-1], axis=0)
+    budget = jnp.clip(b - excl, 0, WORD)
+    t = jnp.zeros(m.shape, jnp.int32)
+    for step in (32, 16, 8, 4, 2, 1):
+        t2 = t + step
+        cnt = jax.lax.population_count(
+            jax.lax.bitcast_convert_type(m & _lowmask(t2), jnp.int32))
+        t = jnp.where((t2 <= WORD) & (cnt <= budget), t2, t)
+    return m & _lowmask(t)
+
+
+def _make_kernel(b: int):
+    def kernel(win_ref, out_ref):
+        out_ref[...] = _first_b_math(win_ref[...], b)
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("b", "interpret"))
+def _call(win_t, *, b, interpret):
+    ww, n = win_t.shape
+    bn = _block_n(ww, n)
+    grid = (_cdiv(n, bn),)
+    return pl.pallas_call(
+        _make_kernel(b),
+        grid=grid,
+        in_specs=[pl.BlockSpec((ww, bn), lambda i: (0, i))],
+        out_specs=pl.BlockSpec((ww, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((ww, n), jnp.uint32),
+        interpret=interpret,
+    )(win_t)
+
+
+def _lax_twin(win_masked, b: int):
+    """jnp lowering: the budgeted lowest-set-bit extract loop — the
+    original (and XLA-fastest) formulation, kept as the semantic home
+    and the non-TPU path."""
+    ww = win_masked.shape[-1]
+    taken = [None] * ww
+    budget = jnp.full(win_masked.shape[:1], b, jnp.int32)
+    for w in range(ww - 1, -1, -1):         # newest word first
+        m = win_masked[:, w]
+        acc = jnp.zeros_like(m)
+        for _ in range(min(b, WORD)):
+            low = m & (jnp.uint32(0) - m)   # lowest set bit (0 if none)
+            bitm = jnp.where(budget > 0, low, jnp.uint32(0))
+            acc = acc | bitm
+            m = m ^ bitm
+            budget = budget - (bitm != 0).astype(jnp.int32)
+        taken[w] = acc
+    return jnp.stack(taken, axis=-1)
+
+
+def select_first_b(win_masked, b: int, impl: str = "auto"):
+    """Mask of the first `b` set bits of each row's window (u32[N, WW],
+    newest word = last column, LSB-first within a word).
+
+    impl: "auto" (pallas on the TPU backend, jnp elsewhere),
+          "pallas" (interpret mode off-TPU), or "lax".
+    """
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(f"bad impl {impl!r}: want auto|pallas|lax")
+    if impl == "lax" or (impl == "auto"
+                         and jax.default_backend() != "tpu"):
+        return _lax_twin(win_masked, b)
+    if _block_n(win_masked.shape[1], win_masked.shape[0]) == 0:
+        if impl == "pallas":
+            raise ValueError(
+                f"window width WW={win_masked.shape[1]} exceeds the "
+                "first-B kernel's scoped-vmem budget; use 'auto' or "
+                "'lax'")
+        return _lax_twin(win_masked, b)
+    interpret = jax.default_backend() != "tpu"
+    return _call(win_masked.T, b=b, interpret=interpret).T

@@ -248,6 +248,54 @@ class TestTopKVals:
         np.testing.assert_array_equal(a, b)
 
 
+class TestSelectFirstB:
+    """Both lowerings of the first-B selection (ops/selb.py: the jnp
+    budgeted extract loop and the Pallas popcount/binary-ascent kernel,
+    interpret mode here) must be bit-for-bit an independent numpy
+    reference of the extract loop: the selection mask IS the piggyback
+    payload, so one different bit changes which rumors disseminate
+    (and breaks the engine↔oracle contract)."""
+
+    @staticmethod
+    def _reference(win_masked, b):
+        import numpy as np
+
+        n, ww = win_masked.shape
+        out = np.zeros_like(win_masked)
+        budget = np.full(n, b, np.int64)
+        for w in range(ww - 1, -1, -1):      # newest word first
+            m = win_masked[:, w].astype(np.uint64)
+            acc = np.zeros(n, np.uint64)
+            for _ in range(min(b, 32)):
+                low = m & (~m + np.uint64(1))        # lowest set bit
+                bitm = np.where(budget > 0, low, 0).astype(np.uint64)
+                acc |= bitm
+                m ^= bitm
+                budget -= (bitm != 0)
+            out[:, w] = acc.astype(np.uint32)
+        return out
+
+    @pytest.mark.parametrize("b", [1, 6, 31, 32, 64, 500])
+    @pytest.mark.parametrize("impl", ["lax", "pallas"])
+    def test_matches_extract_loop(self, b, impl):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from swim_tpu.ops.selb import select_first_b
+
+        rng = np.random.default_rng(b)
+        for n, ww in ((257, 12), (4096, 3), (1000, 1)):
+            # mix of sparse, dense, empty, and full rows
+            win = rng.integers(0, 2**32, (n, ww), dtype=np.uint32)
+            win[rng.random((n, ww)) < 0.3] = 0
+            win[0] = 0
+            win[1] = 0xFFFFFFFF
+            got = np.asarray(select_first_b(jnp.asarray(win), b,
+                                            impl=impl))
+            np.testing.assert_array_equal(
+                got, self._reference(win, b), err_msg=f"b={b} ww={ww}")
+
+
 class TestLiveKnowerCounts:
     """ring.live_knower_counts (the chunked study census) must equal the
     unchunked reference formulation — the [N, RW, 32] expansion it

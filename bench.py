@@ -529,6 +529,15 @@ def main() -> int:
         nodes = n_d if tier == "dense" else n_r
         p = max(periods, 50) if (tier == "dense" and not args.smoke) \
             else periods
+        if tier in ("rumor", "shard") and nodes >= 262_144 \
+                and not args.periods:
+            # The scatter-delivery engines serialize their updates on
+            # TPU and their per-period time DEGRADES with scan length
+            # (measured @1M: 0.61 p/s at 4 periods/dispatch, 0.08 at
+            # 20, device error killed by the tunnel at 50).  Cap the
+            # dispatch so the tier measures the engine instead of the
+            # failure mode; an explicit --periods overrides.
+            p = min(p, 6)
         results[tier] = run_tier(tier, platform, nodes, p,
                                  args.tier_timeout)
         if on_tpu and "timed out" in str(results[tier].get("error", "")):

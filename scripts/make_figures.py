@@ -192,27 +192,30 @@ def fig_suspicion_tradeoff():
 
 
 def fig_perf_sequence():
-    """Round-3 optimization sequence: measured protocol-periods/sec at
-    1M nodes on ONE TPU v5 lite chip after each profile-driven step
-    (docs/RESULTS.md §1; artifacts: bench_all_r2_cache_artifact.json
-    round-3 capture, flagship_tpu_r3.json).  Single series — magnitude over ordered
-    stages — so: bars, one hue, direct value labels, no legend; the
-    dotted line is the fused HBM roofline for the final (period-scope)
-    geometry, the honest single-chip ceiling."""
-    # The stage values are the round-3 HISTORICAL record — each number
-    # is tied to a specific commit and preserved in
-    # bench_results/{bench_all_r2_cache_artifact,flagship_tpu_r3}.json; they are
+    """Rounds 2–4 optimization sequence: measured protocol-periods/sec
+    at 1M nodes on ONE TPU v5 lite chip after each profile-driven step
+    (docs/RESULTS.md §1; artifacts: bench_all_r2_cache_artifact.json,
+    flagship_tpu_r3.json, last_good_tpu.json, bench_all.json).  Single
+    series — magnitude over ordered stages — so: bars, one hue, direct
+    value labels, no legend; the dotted line is the fused HBM roofline
+    for the final geometry (cold-kernel accounting), the honest
+    single-chip ceiling."""
+    # The stage values are the HISTORICAL record — each number is tied
+    # to a specific commit and preserved in bench_results/; they are
     # deliberately frozen here (a recapture updates the artifacts and
-    # future-round tables, not this round's sequence).
+    # future-round tables, not this sequence).
     stages = [
         ("round-2\nbaseline", 2.83),
         ("gathers\n→ rolls", 5.87),
         ("strided-tile\nwalk fixes", 22.8),
         ("+ period-scope\nselection (R5)", 48.2),
-        ("+ hierarchical\ntop-k", 52.2),
+        ("+ hierarchical\ntop-k (r3)", 52.2),
+        ("+ sort-free\ncompaction", 53.6),
+        ("+ Pallas\ncold kernel", 81.0),
+        ("+ selb kernel,\nprobes, RNG", 96.6),
     ]
-    ceiling = 176.2          # fused roofline, period-scope geometry @1M
-    fig, ax = plt.subplots(figsize=(6.4, 3.8), dpi=160)
+    ceiling = 226.0          # fused roofline, cold-kernel accounting @1M
+    fig, ax = plt.subplots(figsize=(7.6, 3.9), dpi=160)
     fig.patch.set_facecolor(SURFACE)
     style_axes(ax)
     xs = np.arange(len(stages))
@@ -226,10 +229,10 @@ def fig_perf_sequence():
                 f"{ceiling:g} p/s", (0.0, ceiling),
                 textcoords="offset points", xytext=(2, 4), ha="left",
                 fontsize=8.5, color=INK2)
-    ax.set_xticks(xs, [s for s, _ in stages], fontsize=8.5)
+    ax.set_xticks(xs, [s for s, _ in stages], fontsize=7.8)
     ax.set_ylim(0, ceiling * 1.12)
     ax.set_ylabel("protocol-periods/sec @ 1M nodes", color=INK)
-    ax.set_title("Ring engine, one TPU v5 lite chip: 18.4× in round 3",
+    ax.set_title("Ring engine, one TPU v5 lite chip: 34× across rounds 2–4",
                  color=INK, fontsize=11, loc="left")
     fig.tight_layout()
     path = os.path.join(OUT, "perf_sequence.png")

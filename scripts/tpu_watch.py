@@ -136,6 +136,19 @@ CAPTURES: list = [
      ["bench.py", "--tier", "ringp", "--nodes", "10000000",
       "--periods", "10", "--tier-timeout", "1500"], 1800, False,
      lambda p: p.get("platform") not in (None, "cpu")),
+    # 16M: the measured single-chip HBM edge after the init-inside-jit
+    # harness fix (state ~10.4 GB single-copy); honest-failure rules as
+    # the 10M row.
+    ("scale_16m",
+     ["bench.py", "--tier", "ringp", "--nodes", "16000000",
+      "--periods", "8", "--tier-timeout", "1500"], 1800, False,
+     lambda p: p.get("platform") not in (None, "cpu")),
+    # Detection law beyond the XLA-CPU envelope (which aborts at 8M):
+    # pull-probe ring engine at 10M on real hardware.
+    ("study_detection_10m",
+     ["-m", "swim_tpu.cli", "study", "detection", "--nodes", "10000000",
+      "--engine", "ring", "--periods", "12",
+      "--crash-fraction", "0.00001"], 3600, False, None),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

@@ -111,6 +111,13 @@ from swim_tpu.sim.faults import FaultPlan
 
 WORD = 32
 
+# Sentinel-expiry probe compaction cap (Phase C): rumors whose sentinel
+# deadlines expire in one period track the origination budget (~OB), so
+# 512 covers steady state with ~8x headroom; a burst beyond it takes the
+# exact full-batch branch of the lax.cond.  Module-level so tests can
+# force either branch (tests/test_ring.py pins them bitwise-equal).
+_SENTINEL_QUERY_CAP = 512
+
 
 class RingGeometry(NamedTuple):
     """Static geometry derived from SwimConfig (plain Python ints)."""
@@ -1202,9 +1209,48 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         okey = ops.gather(top_key[lvl], subj_r)
         cands.append(((okey > rkey) & (oslot >= 0))[:, None])
         oslots.append(jnp.broadcast_to(oslot[:, None], snode.shape))
-    kn_b = knows_bit(jnp.concatenate([snode_cl] * g.c, axis=1),
-                     jnp.concatenate(oslots, axis=1))
     s_lanes = snode.shape[1]
+    rows_b = jnp.concatenate([snode_cl] * g.c, axis=1)      # [R, S*C]
+    slots_b = jnp.concatenate(oslots, axis=1)
+
+    # The probe results are consumed ONLY where a sentinel deadline
+    # expired this period (can_confirm = deadline_hit & ~higher_known),
+    # and expiries per period track the origination budget (~OB), not
+    # the table size R — so in steady state the [R, S*C] batch gathers
+    # ~48k elements to use a few hundred.  Exact two-tier evaluation:
+    # compact the expiring rumor rows (first_true on the REPLICATED
+    # [R] hit vector — plain _first_true_idx, not the node-axis
+    # ops.first_true_nodes) and probe only those; if a burst overflows
+    # the cap, fall back to the full batch inside lax.cond (both
+    # branches exact; TPU gather cost is per-element, so the small
+    # branch is the ~0.9 ms/period saving measured at 1M).  Works
+    # under BOTH ops: the predicate is computed from replicated data,
+    # so every shard takes the same cond branch, and ShardOps'
+    # knows_words psum shrinks with the compacted query
+    # (tests/test_ring_shard.py pins sharded == single-program
+    # bitwise; test_sentinel_query_cap_branches_bitwise_equal pins the
+    # branches against each other).
+    hit_r = jnp.any(deadline_hit, axis=-1)                  # [R]
+    cap = min(_SENTINEL_QUERY_CAP, r_tot)
+    if getattr(ops, "supports_random_gather", False) and cap < r_tot:
+        rid = _first_true_idx(hit_r, cap)                   # [cap]
+        rid_cl = jnp.minimum(rid, r_tot - 1)
+
+        def _compacted(_):
+            rows_c = rows_b[rid_cl]                         # [cap, S*C]
+            slots_c = slots_b[rid_cl]
+            kn_c = knows_bit(rows_c, slots_c)
+            return (jnp.zeros(rows_b.shape, jnp.bool_)
+                    .at[rid].set(kn_c, mode="drop"))
+
+        def _full(_):
+            return knows_bit(rows_b, slots_b)
+
+        kn_b = jax.lax.cond(
+            jnp.sum(hit_r.astype(jnp.int32)) <= cap,
+            _compacted, _full, None)
+    else:
+        kn_b = knows_bit(rows_b, slots_b)
     for lvl in range(g.c):
         kn = kn_b[:, lvl * s_lanes:(lvl + 1) * s_lanes]
         higher_known = higher_known | (cands[lvl] & kn)

@@ -88,6 +88,41 @@ class TestBitwiseVsOracle:
         plan = faults.with_partition(plan, faults.halves(n), 3, 9)
         run_both(cfg, plan, 16, seed=4)
 
+    def test_sentinel_query_cap_branches_bitwise_equal(self):
+        """The sentinel-expiry probe compaction (Phase C lax.cond) must
+        be invisible: cap=0 forces the full-batch branch whenever any
+        deadline expires, cap>=R disables the cond entirely, and the
+        default takes the compacted branch — all three trajectories
+        must be bitwise identical through a crash lifecycle."""
+        import jax.numpy as jnp
+
+        n = 32
+        cfg = SwimConfig(n_nodes=n)
+        plan = faults.with_crashes(faults.none(n), [5, 11], [2])
+        key = jax.random.key(9)
+
+        def run_with_cap(cap):
+            old = ring._SENTINEL_QUERY_CAP
+            ring._SENTINEL_QUERY_CAP = cap
+            try:
+                est = ring.init_state(cfg)
+                # no jit cache reuse across caps: trace fresh each time
+                for t in range(26):
+                    rnd = ring.draw_period_ring(key, t, cfg)
+                    est = ring.step(cfg, est, plan, rnd)
+            finally:
+                ring._SENTINEL_QUERY_CAP = old
+            return est
+
+        base = run_with_cap(ring._SENTINEL_QUERY_CAP)
+        for cap in (0, 10**9):
+            got = run_with_cap(cap)
+            for name, a in base._asdict().items():
+                b = getattr(got, name)
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"{name} differs at cap={cap}")
+
     def test_join_churn(self):
         """Late joiners + crash + rejoin-as-fresh-id, bitwise."""
         n = 24

@@ -526,7 +526,8 @@ def _window_overlay(g: RingGeometry, step) -> tuple[jax.Array, jax.Array]:
 
 def live_knower_counts(cfg: SwimConfig, state: RingState,
                        up: jax.Array,
-                       chunk_words: int | None = None) -> jax.Array:
+                       chunk_words: int | None = None,
+                       pair_budget: int = 1 << 23) -> jax.Array:
     """i32[R]: per-ring-slot count of live ("up") nodes holding the bit.
 
     The study runner's census.  Computed split by storage (win vs cold)
@@ -543,12 +544,23 @@ def live_knower_counts(cfg: SwimConfig, state: RingState,
     n = cfg.n_nodes
 
     def counts_of(rows):                        # [cw, N] word-major
-        # _lane_counts IS this census kernel; reuse it per chunk
-        return _lane_counts(rows, up).reshape(-1, WORD)
+        # _lane_counts IS this census kernel; reuse it per chunk.
+        # Beyond ~8.4M nodes even ONE word row exceeds the 2 GiB
+        # budget (the 16M study OOM'd by 620 MB on exactly this), so
+        # the node axis splits too — integer partial sums, bitwise-
+        # identical in any split.
+        if rows.shape[0] * rows.shape[1] <= pair_budget:
+            return _lane_counts(rows, up).reshape(-1, WORD)
+        seg = max(1, pair_budget // rows.shape[0])
+        tot = None
+        for c in range(0, rows.shape[1], seg):
+            part = _lane_counts(rows[:, c:c + seg], up[c:c + seg])
+            tot = part if tot is None else tot + part
+        return tot.reshape(-1, WORD)
 
     # 2^23 word-node pairs x (4 B u32 bits + 4 B i32 masked) x 32 bits
     # ~= 2 GiB of expanded intermediates per chunk
-    cw = chunk_words or max(1, (1 << 23) // max(n, 1))
+    cw = chunk_words or max(1, pair_budget // max(n, 1))
     counts_cold = jnp.concatenate(
         [counts_of(state.cold[c:c + cw]) for c in range(0, g.rw, cw)])
     win_t = state.win.T                         # [WW, N]

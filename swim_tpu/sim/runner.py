@@ -83,7 +83,7 @@ def _update_track(track: StudyTrack, state: dense.DenseState,
     )
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4))
+@functools.partial(jax.jit, static_argnums=(0, 4), donate_argnums=(1,))
 def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
               root_key: jax.Array, periods: int) -> StudyResult:
     n = cfg.n_nodes
@@ -174,7 +174,7 @@ def _rumor_subject_flags(cfg: SwimConfig, st, up: jax.Array):
                           gone_dead, gone_dead)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(1,))
 def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
                     root_key: jax.Array, periods: int,
                     step_fn=None) -> RumorStudyResult:
@@ -229,7 +229,12 @@ class RingStudyResult(NamedTuple):
     series: PeriodSeries
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+# `state` is donated in all three study runners: every caller builds it
+# fresh for the call, and a non-donated 10M-node ring state (~6.4 GB)
+# held next to the scan carry exceeded the 16 GB HBM (the same
+# double-residency the bench harness hit at 10M, fixed there by
+# init-inside-jit; donation is the API-preserving form here).
+@functools.partial(jax.jit, static_argnums=(0, 4, 5), donate_argnums=(1,))
 def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
                    root_key: jax.Array, periods: int,
                    step_fn=None) -> RingStudyResult:

@@ -326,6 +326,14 @@ class TestLiveKnowerCounts:
             # chunk_words=3 forces multiple, unevenly-dividing chunks
             got = np.asarray(ring.live_knower_counts(cfg, state, up,
                                                      chunk_words=3))
+            # a tiny pair budget additionally forces the NODE-axis
+            # split inside each chunk (the >8.4M-node path, where one
+            # word row alone exceeds the expansion budget) — partial
+            # integer sums must stay bit-identical
+            got_split = np.asarray(ring.live_knower_counts(
+                cfg, state, up, chunk_words=3, pair_budget=5000))
+            np.testing.assert_array_equal(got_split, got,
+                                          err_msg=f"split t={t}")
             words = ring.resolved_words(cfg, state)
             live_words = jnp.where(up[:, None], words, jnp.uint32(0))
             bits = (live_words[:, :, None]

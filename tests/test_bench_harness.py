@@ -107,6 +107,76 @@ class TestLastGoodTpuGate:
         assert "full" not in rec          # bulky echo stripped on load
         assert rec["commit"] and rec["captured_at"]
 
+    def test_slow_window_cannot_erase_best(self, tmp_path, monkeypatch):
+        """`last_good` is LATEST but `best` is MAX: the tunnel's >2x
+        window-to-window variance must never let a slow capture erase
+        the defended best (the exact undersell hazard of VERDICT r3)."""
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                            str(tmp_path / "lg.json"))
+        base = {"unit": "periods/sec", "metric": "m", "vs_baseline": 0.01}
+        bench.save_last_good_tpu({**base, "value": 96.9})
+        bench.save_last_good_tpu({**base, "value": 35.2})   # slow window
+        rec = bench.load_last_good_tpu()
+        assert rec["value"] == 35.2                 # honest recency
+        assert rec["best"]["value"] == 96.9         # defended max kept
+        bench.save_last_good_tpu({**base, "value": 105.7})  # new record
+        rec = bench.load_last_good_tpu()
+        assert rec["value"] == 105.7
+        assert rec["best"]["value"] == 105.7
+
+    def test_pre_best_record_migrates(self, tmp_path, monkeypatch):
+        """A record written before the `best` field existed migrates:
+        its (higher) value becomes the best, not lost to latest-wins."""
+        import json as _json
+        path = tmp_path / "lg.json"
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+        old = {"value": 96.9, "unit": "periods/sec", "metric": "m",
+               "vs_baseline": 0.01, "captured_at": "x", "commit": "c"}
+        path.write_text(_json.dumps(old))
+        bench.save_last_good_tpu({"value": 40.0, "unit": "periods/sec",
+                                  "metric": "m", "vs_baseline": 0.004})
+        rec = bench.load_last_good_tpu()
+        assert rec["value"] == 40.0
+        assert rec["best"]["value"] == 96.9
+
+    def test_best_only_comparable_at_same_metric(self, tmp_path,
+                                                 monkeypatch):
+        """A higher value at a DIFFERENT headline config (metric string)
+        must not be carried as this config's best — apples to apples."""
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH",
+                            str(tmp_path / "lg.json"))
+        bench.save_last_good_tpu({"value": 96.9, "unit": "periods/sec",
+                                  "metric": "1M ringp",
+                                  "vs_baseline": 0.01})
+        bench.save_last_good_tpu({"value": 29.0, "unit": "periods/sec",
+                                  "metric": "4M ringp",
+                                  "vs_baseline": 0.003})
+        rec = bench.load_last_good_tpu()
+        assert rec["value"] == 29.0
+        assert rec["best"]["value"] == 29.0     # not the 1M record
+        # ...and the metric switch did NOT erase the 1M best: a later
+        # capture back at the 1M config sees its defended record again
+        bench.save_last_good_tpu({"value": 35.0, "unit": "periods/sec",
+                                  "metric": "1M ringp",
+                                  "vs_baseline": 0.0035})
+        rec = bench.load_last_good_tpu()
+        assert rec["best"]["value"] == 96.9
+        assert rec["bests"]["4M ringp"]["value"] == 29.0
+
+    def test_corrupt_best_discarded_not_fatal(self, tmp_path,
+                                              monkeypatch):
+        """A corrupt `best` shape in the existing file is discarded;
+        it must never abort the save (which would freeze the record)."""
+        import json as _json
+        path = tmp_path / "lg.json"
+        monkeypatch.setattr(bench, "LAST_GOOD_PATH", str(path))
+        path.write_text(_json.dumps({"value": 96.9, "best": "oops"}))
+        bench.save_last_good_tpu({"value": 40.0, "unit": "periods/sec",
+                                  "metric": "m", "vs_baseline": 0.004})
+        rec = bench.load_last_good_tpu()
+        assert rec["value"] == 40.0
+        assert rec["best"]["value"] == 40.0
+
     def test_load_missing_returns_none(self, tmp_path, monkeypatch):
         monkeypatch.setattr(bench, "LAST_GOOD_PATH",
                             str(tmp_path / "absent.json"))

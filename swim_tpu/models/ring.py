@@ -309,13 +309,22 @@ class PullRandomness(NamedTuple):
 class RingRandomness(NamedTuple):
     s_off: jax.Array    # i32 scalar: probe offset in [1, N)   (rotor)
     q_off: jax.Array    # i32[k]:  proxy offsets in [1, N)     (rotor)
-    loss_w1: jax.Array  # f32[N]                               (rotor)
-    loss_w2: jax.Array  # f32[N]                               (rotor)
-    loss_w3: jax.Array  # f32[N, k]                            (rotor)
-    loss_w4: jax.Array  # f32[N, k]                            (rotor)
-    loss_w5: jax.Array  # f32[N, k]                            (rotor)
-    loss_w6: jax.Array  # f32[N, k]                            (rotor)
-    lha_u: jax.Array    # f32[N]  Lifeguard probe thinning     (rotor)
+    # The six loss legs and the LHA draw are raw u16 values carried in
+    # u32 (0..65535), threshold-compared by consumers IN INTEGERS:
+    # `bits >= ceil(loss*65536)` == the former `bits/65536 >= loss`
+    # exactly (bits/65536 is exact in f32; 65536*loss is an exponent
+    # shift; ceil is exact), and `bits*(1+s) < 65536` == the former
+    # `bits/65536 < fl(1/(1+s))` — verified exhaustively over every
+    # (bits, s) pair, s in [0,256].  Carrying bits instead of f32
+    # uniforms removes the four [N,k] convert-multiply materializations
+    # the round-4 TPU profile measured at 0.36 ms/period @ 1M.
+    loss_w1: jax.Array  # u32[N]   u16 draw                    (rotor)
+    loss_w2: jax.Array  # u32[N]   u16 draw                    (rotor)
+    loss_w3: jax.Array  # u32[N, k] u16 draw                   (rotor)
+    loss_w4: jax.Array  # u32[N, k] u16 draw                   (rotor)
+    loss_w5: jax.Array  # u32[N, k] u16 draw                   (rotor)
+    loss_w6: jax.Array  # u32[N, k] u16 draw                   (rotor)
+    lha_u: jax.Array    # u32[N]   u16 draw, probe thinning    (rotor)
     pull: PullRandomness | None = None          # pull mode only
 
 
@@ -339,7 +348,7 @@ def draw_period_ring(key: jax.Array, step, cfg: SwimConfig) -> RingRandomness:
     kk = jax.random.fold_in(key, step)
     if cfg.ring_probe == "pull":
         ks = jax.random.split(kk, 9)
-        zero = jnp.zeros((0,), jnp.float32)
+        zero = jnp.zeros((0,), jnp.uint32)
         return RingRandomness(
             s_off=s_off.astype(jnp.int32), q_off=q_off.astype(jnp.int32),
             loss_w1=zero, loss_w2=zero, loss_w3=zero, loss_w4=zero,
@@ -362,15 +371,16 @@ def draw_period_ring(key: jax.Array, step, cfg: SwimConfig) -> RingRandomness:
     # bits: 4 [N] + 2 [N, k] raw draws instead of 3 [N] + 4 [N, k]
     # f32 uniforms (the period RNG measured 0.67 ms at the 1M
     # flagship — the generation, not the use, is the cost).  The
-    # oracle consumes these same tensors (ring_oracle.py), so the
-    # bitwise engine<->oracle contract is unaffected by HOW they are
-    # drawn.
+    # halves stay RAW u16 integers (see RingRandomness): consumers
+    # compare in the integer domain, a proven-exact rewrite of the
+    # former f32 compares, so no [N,k]-sized float conversion is ever
+    # materialized.  The oracle consumes these same tensors
+    # (ring_oracle.py), so the bitwise engine<->oracle contract is
+    # unaffected by HOW they are drawn.
     ks = jax.random.split(kk, 4)
-    inv = jnp.float32(1.0 / 65536.0)
 
     def halves(bits):
-        return ((bits & jnp.uint32(0xFFFF)).astype(jnp.float32) * inv,
-                (bits >> 16).astype(jnp.float32) * inv)
+        return (bits & jnp.uint32(0xFFFF), bits >> 16)
 
     w12 = jax.random.bits(ks[0], (n,), jnp.uint32)
     w34 = jax.random.bits(ks[1], (n, k), jnp.uint32)
@@ -880,6 +890,10 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # ---- Phases A+B+probe-verdicts, per probe pattern ---------------------
     pid = plan.partition_id
     loss_f = plan.loss.astype(jnp.float32)
+    # integer loss threshold: bits >= ceil(loss*65536) == u >= loss
+    # exactly (see RingRandomness); 65536*loss is an exact exponent
+    # shift in f32 and ceil is exact, so no boundary sample can flip
+    loss_thr = jnp.ceil(loss_f * jnp.float32(65536.0)).astype(jnp.uint32)
     b_pig = min(cfg.max_piggyback, g.ww * WORD)
     win_slots_lin = jnp.mod(win_ring0 * WORD
                             + jnp.arange(g.ww * WORD, dtype=jnp.int32),
@@ -952,7 +966,7 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             """bool[N] per receiver i: the message from (i+d) arrived."""
             return (roll_from(send_flag_at_sender, d) & active
                     & ~(part_on & (roll_from(pid, d) != pid))
-                    & (u >= loss_f))
+                    & (u >= loss_thr))
 
         # W1: ping i -> i+s.  Receiver j hears from sender j−s.
         sel1 = sel_now(buddy_bits(s_off))
@@ -1004,8 +1018,11 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             lha = jnp.where(prober,
                             jnp.clip(lha + jnp.where(failed, 1, -1), 0,
                                      cfg.lha_max), lha)
-            thin = rnd.lha_u < (jnp.float32(1.0)
-                                / (1 + s_probe).astype(jnp.float32))
+            # bits*(1+s) < 65536 == bits/65536 < fl(1/(1+s)) for every
+            # (bits, s), s <= 256 — checked exhaustively (RingRandomness)
+            assert cfg.lha_max <= 256, "integer thin compare verified to 256"
+            thin = (rnd.lha_u * (1 + s_probe).astype(jnp.uint32)
+                    < jnp.uint32(65536))
             failed = failed & thin
         # view_of(ids, target) + Phase C's self-suspicion word, fused:
         # subject tables roll (target is a rotation of ids), and all C+1
@@ -1242,7 +1259,22 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # (tests/test_ring_shard.py pins sharded == single-program
     # bitwise; test_sentinel_query_cap_branches_bitwise_equal pins the
     # branches against each other).
-    hit_r = jnp.any(deadline_hit, axis=-1)                  # [R]
+    # Only rows whose probe could still flip a confirm THIS period are
+    # worth probing.  The deadline test is `>=`, so a row keeps
+    # "hitting" every period until it is recycled — stale rows
+    # (already confirmed, no longer suspect, out-ranked by the
+    # subject's known death, or an unused lane) accumulate until
+    # sum(hit_r) overflows any fixed cap: the round-4 TPU profile
+    # measured the full-batch cond branch firing 34/50 periods at 1M
+    # for this reason alone.  Their kn values are dead code — `confirm`
+    # repeats exactly these conjuncts — and every gate input is
+    # replicated under ShardOps (rkey/subject tables are replicated;
+    # gone_at_r is already gathered for higher_known), so the cond
+    # predicate stays shard-uniform and both branches stay exact.
+    dead_key_r = lattice.dead_key(lattice.incarnation_of(rkey))
+    actionable = (used & is_susp_r & ~confirmed
+                  & (dead_key_r > gone_at_r) & ~(gone_at_r > rkey))
+    hit_r = jnp.any(deadline_hit, axis=-1) & actionable     # [R]
     cap = min(_SENTINEL_QUERY_CAP, r_tot)
     if getattr(ops, "supports_random_gather", False) and cap < r_tot:
         rid = _first_true_idx(hit_r, cap)                   # [cap]
@@ -1267,7 +1299,6 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         kn = kn_b[:, lvl * s_lanes:(lvl + 1) * s_lanes]
         higher_known = higher_known | (cands[lvl] & kn)
     can_confirm = deadline_hit & ~higher_known
-    dead_key_r = lattice.dead_key(lattice.incarnation_of(rkey))
     confirm = (used & is_susp_r & ~confirmed
                & (dead_key_r > gone_at_r)
                & jnp.any(can_confirm, axis=-1))

@@ -248,12 +248,17 @@ class RingOracle:
                 return [e[1]]
             return []
 
-        def delivered(src: int, dst: int, uu: float) -> bool:
+        # integer loss threshold, mirroring the engine exactly: the
+        # loss_w*/lha_u tensors carry raw u16 draws and delivery is
+        # bits >= ceil(loss*65536) (see ring.RingRandomness)
+        loss_thr = int(np.ceil(np.float32(loss) * np.float32(65536.0)))
+
+        def delivered(src: int, dst: int, uu: int) -> bool:
             if not (active[src] and active[dst]):
                 return False
             if part_on and pid[src] != pid[dst]:
                 return False
-            return uu >= loss
+            return uu >= loss_thr
 
         lha = st.lha.copy()
         if cfg.ring_probe == "rotor":
@@ -270,7 +275,7 @@ class RingOracle:
             for j in range(n):
                 i = (j - s_off) % n
                 if i in w1_payload and delivered(i, j,
-                                                 float(u["loss_w1"][j])):
+                                                 int(u["loss_w1"][j])):
                     ok1[j] = True
             for j in np.nonzero(ok1)[0]:
                 for sl in w1_payload[(j - s_off) % n]:
@@ -283,7 +288,7 @@ class RingOracle:
             for i in range(n):
                 j = (i + s_off) % n
                 if j in w2_payload and delivered(j, i,
-                                                 float(u["loss_w2"][i])):
+                                                 int(u["loss_w2"][i])):
                     ok2[i] = True
             for i in np.nonzero(ok2)[0]:
                 for sl in w2_payload[(i + s_off) % n]:
@@ -301,7 +306,7 @@ class RingOracle:
                 for p in range(n):
                     i = (p - q) % n
                     if i in p3 and delivered(i, p,
-                                             float(u["loss_w3"][p, a])):
+                                             int(u["loss_w3"][p, a])):
                         ok3[p] = True
                 for p in np.nonzero(ok3)[0]:
                     for sl in p3[(p - q) % n]:
@@ -315,7 +320,7 @@ class RingOracle:
                 for j in range(n):
                     p = (j - d4) % n
                     if p in p4 and delivered(p, j,
-                                             float(u["loss_w4"][j, a])):
+                                             int(u["loss_w4"][j, a])):
                         ok4[j] = True
                 for j in np.nonzero(ok4)[0]:
                     for sl in p4[(j - d4) % n]:
@@ -327,7 +332,7 @@ class RingOracle:
                 for p in range(n):
                     j = (p + d4) % n
                     if j in p5 and delivered(j, p,
-                                             float(u["loss_w5"][p, a])):
+                                             int(u["loss_w5"][p, a])):
                         ok5[p] = True
                 for p in np.nonzero(ok5)[0]:
                     for sl in p5[(p + d4) % n]:
@@ -339,7 +344,7 @@ class RingOracle:
                 for i in range(n):
                     p = (i + q) % n
                     if p in p6 and delivered(p, i,
-                                             float(u["loss_w6"][i, a])):
+                                             int(u["loss_w6"][i, a])):
                         ok6[i] = True
                 for i in np.nonzero(ok6)[0]:
                     for sl in p6[(i + q) % n]:
@@ -355,8 +360,8 @@ class RingOracle:
                         lha[i] = min(max(lha[i] + (1 if failed[i] else -1),
                                          0), cfg.lha_max)
                 for i in range(n):
-                    if failed[i] and not (float(u["lha_u"][i])
-                                          < 1.0 / (1 + int(s_probe[i]))):
+                    if failed[i] and not (int(u["lha_u"][i])
+                                          * (1 + int(s_probe[i])) < 65536):
                         failed[i] = False
             susp_sub = list(tgt)
             susp_org = list(range(n))

@@ -1,0 +1,225 @@
+"""Fused multi-wave window OR-merge (TPU Pallas kernel).
+
+Why this kernel exists (round-4 TPU trace, docs/RESULTS.md §1): in
+period-selection scope every rotor period ORs the SAME start-of-period
+piggyback selection into the window at 2+4k rolled offsets —
+
+    win |= ok_w ? roll(sel, off_w) : 0        for each of V=14 waves
+
+XLA fuses all fourteen terms into one kLoop fusion (good), but each
+roll lowers to a pair of dynamic slices along the node axis, which is
+the MINOR (lane) dimension of the `{0,1}`-laid-out [N, WW] window —
+so every vector load is lane-misaligned and the fusion ran at ~2.5x
+its streaming floor (measured 2.29 ms/period of the 8.27 ms 1M-node
+period, the largest single op).
+
+Here the rolls become plain contiguous DMAs: in the transposed
+[WW, N] view each word row is contiguous along N, so a roll is just a
+dynamic column offset.  Per output block the kernel issues one DMA
+per wave from a wrap-padded selection buffer (8-32 KB contiguous runs
+— ideal DMA shapes, no lane shuffles), overlaps all V transfers, and
+ORs them under the receiver's delivery mask.  The buddy-forced bits
+(at most one word per receiver, waves W1/W4a) ride along as compact
+(col, val) vectors instead of materialized [N, WW] one-hots.
+
+Semantics (bitwise twin pinned by tests/test_wavemerge.py):
+
+    out[i] = win[i]
+             | OR_w  (oks[w, i] ? sel[(i + offs[w]) mod N] : 0)
+             | OR_q  onehot(bcol[q, i]) * bval[q, i]
+
+Delivery masks are indexed by RECEIVER, so they ride the output block
+(lane-local); only the selection reads are offset.  The last grid
+block self-clamps its start to N-T and recomputes the overlap region
+with identical inputs (idempotent bit-ORs), so the kernel performs
+the SAME arithmetic on every backend — no reliance on ragged-block
+padding/clamping semantics, which differ between Mosaic and interpret
+mode.  Wraparound reads come from a T-column wrap pad, never a
+data-dependent second DMA.
+
+The reference tree is unavailable (see SURVEY.md §0); the protocol
+semantics this implements are the W1-W6 gossip deliveries documented
+at models/ring.py Phases A/B and docs/PROTOCOL.md §3.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _block_t(v: int, ww: int, n: int) -> int:
+    """Node-axis block width (lanes).
+
+    VMEM is dominated by the V per-wave selection buffers ([V, WW, T]
+    u32) plus the accumulator; budget ~8 MB for them, keep T a
+    128-lane multiple, and cap at 8192 (123 blocks at the 1M
+    flagship: DMA issue overhead amortizes, transfers overlap).
+    Returns 0 when no 128-wide block fits the budget or when n is too
+    small to clamp against (the twin handles those)."""
+    budget = (8 * 1024 * 1024) // ((v + 1) * ww * 4)
+    t = min(8192, (budget // 128) * 128, (n // 128) * 128)
+    return t if t >= 128 and n >= t else 0
+
+
+def _make_kernel(n: int, t: int, v: int, vb: int, ww: int):
+    def kernel(offs_ref, sel_ref, win_ref, ok_ref, bcol_ref, bval_ref,
+               out_ref, accv, selv, okv, bcolv, bvalv, sems, sout):
+        i = pl.program_id(0)
+        start = jnp.minimum(i * t, n - t)
+
+        # issue every read up front; transfers overlap
+        cps = []
+        cp = pltpu.make_async_copy(win_ref.at[:, pl.ds(start, t)],
+                                   accv, sems.at[0])
+        cp.start()
+        cps.append(cp)
+        cp = pltpu.make_async_copy(ok_ref.at[:, pl.ds(start, t)],
+                                   okv, sems.at[1])
+        cp.start()
+        cps.append(cp)
+        cp = pltpu.make_async_copy(bcol_ref.at[:, pl.ds(start, t)],
+                                   bcolv, sems.at[2])
+        cp.start()
+        cps.append(cp)
+        cp = pltpu.make_async_copy(bval_ref.at[:, pl.ds(start, t)],
+                                   bvalv, sems.at[3])
+        cp.start()
+        cps.append(cp)
+        sel_cps = []
+        for w in range(v):
+            src = start + offs_ref[w]
+            src = jnp.where(src >= n, src - n, src)   # offs in [0, n)
+            cp = pltpu.make_async_copy(sel_ref.at[:, pl.ds(src, t)],
+                                       selv.at[w], sems.at[4 + w])
+            cp.start()
+            sel_cps.append(cp)
+
+        cps[0].wait()                                  # win -> acc
+        cps[1].wait()                                  # ok bits
+        acc = accv[...]
+        okb = okv[...]                                 # u32[1, T]
+        zero = jnp.zeros((), jnp.uint32)
+        for w in range(v):
+            sel_cps[w].wait()
+            hit = ((okb >> w) & jnp.uint32(1)) > 0     # [1, T]
+            acc = acc | jnp.where(hit, selv[w], zero)
+        cps[2].wait()
+        cps[3].wait()
+        riota = jax.lax.broadcasted_iota(jnp.int32, (ww, t), 0)
+        for q in range(vb):
+            acc = acc | jnp.where(riota == bcolv[q:q + 1, :],
+                                  bvalv[q:q + 1, :], zero)
+        accv[...] = acc
+        cp = pltpu.make_async_copy(accv, out_ref.at[:, pl.ds(start, t)],
+                                   sout)
+        cp.start()
+        cp.wait()
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("t", "interpret"))
+def _call(offs, sel_pad_t, win_t, okbits, bcol, bval, *, t, interpret):
+    ww, n = win_t.shape
+    v = int(offs.shape[0])
+    vb = int(bcol.shape[0])
+    grid = (_cdiv(n, t),)
+    return pl.pallas_call(
+        _make_kernel(n, t, v, vb, ww),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 5,
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((ww, t), jnp.uint32),        # accumulator
+                pltpu.VMEM((v, ww, t), jnp.uint32),     # per-wave sel
+                pltpu.VMEM((1, t), jnp.uint32),         # ok bits
+                pltpu.VMEM((vb, t), jnp.int32),         # buddy cols
+                pltpu.VMEM((vb, t), jnp.uint32),        # buddy vals
+                pltpu.SemaphoreType.DMA((4 + v,)),
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((ww, n), jnp.uint32),
+        # out reuses win's buffer: every block fully reads its window
+        # region before its output DMA starts, and the clamped last
+        # block rewrites the overlap with identical values
+        input_output_aliases={2: 0},
+        interpret=interpret,
+    )(offs, sel_pad_t, win_t, okbits, bcol, bval)
+
+
+def _lax_twin(win, sel, oks, offs, bcol, bval):
+    """jnp lowering — the pre-kernel rolled-OR formulation, kept as
+    the non-TPU path and the bitwise contract for the kernel tests."""
+    ww = win.shape[1]
+    zero = jnp.zeros((), jnp.uint32)
+    out = win
+    for w in range(oks.shape[0]):
+        rolled = jnp.roll(sel, -offs[w], axis=0)
+        out = out | jnp.where(oks[w][:, None], rolled, zero)
+    wids = jnp.arange(ww, dtype=jnp.int32)[None, :]
+    for q in range(bcol.shape[0]):
+        out = out | jnp.where(bcol[q][:, None] == wids,
+                              bval[q][:, None], zero)
+    return out
+
+
+def merge_waves(win, sel, oks, offs, bcol, bval, impl: str = "auto",
+                block_t: int | None = None):
+    """OR V rolled, delivery-masked selection payloads plus VB forced
+    bits into the window.
+
+    win:  u32[N, WW]  receiver windows (carry-in)
+    sel:  u32[N, WW]  start-of-period selection payload (sender rows)
+    oks:  bool[V, N]  per-wave delivery mask, indexed by RECEIVER
+    offs: i32[V]      receiver i hears sel row (i + offs[v]) mod N
+                      (traced scalars fine; any sign/magnitude)
+    bcol: i32[VB, N]  receiver-aligned forced-bit window column
+    bval: u32[VB, N]  forced bit value (0 = no contribution; the col
+                      of a zero-val entry is ignored)
+    impl: "auto" (pallas on the TPU backend, jnp elsewhere),
+          "pallas" (interpret mode off-TPU), or "lax"
+
+    Returns u32[N, WW].
+    """
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(f"bad impl {impl!r}: want auto|pallas|lax")
+    n, ww = win.shape
+    v = oks.shape[0]
+    if v > 32:
+        raise ValueError(f"V={v} waves exceed the 32-bit ok pack")
+    offs = jnp.asarray(offs, jnp.int32)
+    offs = jnp.mod(jnp.mod(offs, n) + n, n)
+    if impl == "lax" or (impl == "auto"
+                         and jax.default_backend() != "tpu"):
+        return _lax_twin(win, sel, oks, offs, bcol, bval)
+    t = block_t if block_t is not None else _block_t(v, ww, n)
+    if t == 0:
+        # No viable block: tiny N (< one 128-lane tile) or a
+        # VMEM-hostile geometry.  Block STARTS need no alignment —
+        # DMAs are byte-addressed, and the wave source offsets are
+        # arbitrary by construction — so any n >= t works.
+        if impl == "pallas":
+            raise ValueError(
+                f"no viable merge block for N={n}, WW={ww}, V={v}; "
+                "use impl='auto' or 'lax'")
+        return _lax_twin(win, sel, oks, offs, bcol, bval)
+    okbits = jnp.zeros((n,), jnp.uint32)
+    for w in range(v):
+        okbits = okbits | (oks[w].astype(jnp.uint32) << w)
+    sel_t = sel.T
+    sel_pad = jnp.concatenate([sel_t, sel_t[:, :t]], axis=1)
+    interpret = jax.default_backend() != "tpu"
+    out_t = _call(offs, sel_pad, win.T, okbits[None, :],
+                  bcol.astype(jnp.int32), bval.astype(jnp.uint32),
+                  t=t, interpret=interpret)
+    return out_t.T

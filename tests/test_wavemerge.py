@@ -1,0 +1,128 @@
+"""Bitwise contract for the fused wave-merge kernel (ops/wavemerge.py).
+
+The kernel (interpret mode on CPU) must match the jnp twin
+element-for-element on every shape class it will see in production:
+block-aligned, ragged (clamped last block recomputing the overlap),
+unaligned N, zero offsets, wrapping offsets, negative offsets, all-off
+masks, and inert buddy rows.  An independent numpy reference guards
+the twin itself.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swim_tpu.ops import wavemerge
+
+
+def _numpy_ref(win, sel, oks, offs, bcol, bval):
+    n, ww = win.shape
+    out = np.asarray(win).copy()
+    for w in range(oks.shape[0]):
+        src = (np.arange(n) + int(offs[w])) % n
+        contrib = np.where(np.asarray(oks[w])[:, None],
+                           np.asarray(sel)[src], np.uint32(0))
+        out |= contrib
+    for q in range(bcol.shape[0]):
+        cols = np.asarray(bcol[q])
+        vals = np.asarray(bval[q])
+        for i in range(n):
+            if 0 <= cols[i] < ww and vals[i]:
+                out[i, cols[i]] |= vals[i]
+    return out
+
+
+def _mk(n, ww, v, vb, seed=0, offs=None):
+    k = jax.random.key(seed)
+    ks = jax.random.split(k, 6)
+    win = jax.random.bits(ks[0], (n, ww), jnp.uint32)
+    sel = jax.random.bits(ks[1], (n, ww), jnp.uint32)
+    oks = jax.random.bernoulli(ks[2], 0.4, (v, n))
+    if offs is None:
+        offs = jax.random.randint(ks[3], (v,), -2 * n, 2 * n)
+    offs = jnp.asarray(offs, jnp.int32)
+    bcol = jax.random.randint(ks[4], (vb, n), -1, ww + 2)
+    bit = jax.random.randint(ks[5], (vb, n), 0, 32)
+    bval = jnp.where(jax.random.bernoulli(ks[5], 0.3, (vb, n)),
+                     jnp.uint32(1) << bit.astype(jnp.uint32),
+                     jnp.uint32(0))
+    return win, sel, oks, offs, bcol, bval
+
+
+CASES = [
+    # (n, ww, v, vb, block_t)  — block_t None => derived
+    (1024, 12, 14, 4, 256),      # 4 aligned blocks
+    (1000, 12, 14, 4, 256),      # ragged: clamped last block overlap
+    (1280, 4, 14, 4, 128),       # lean window, 10 blocks
+    (640, 12, 5, 1, 128),        # few waves, one buddy row
+    (256, 12, 14, 4, 256),       # single block == whole array
+]
+
+
+class TestKernelVsTwin:
+    @pytest.mark.parametrize("n,ww,v,vb,bt", CASES)
+    def test_bitwise(self, n, ww, v, vb, bt):
+        win, sel, oks, offs, bcol, bval = _mk(n, ww, v, vb, seed=n + v)
+        twin = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                     impl="lax")
+        kern = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                     impl="pallas", block_t=bt)
+        np.testing.assert_array_equal(np.asarray(twin), np.asarray(kern))
+
+    def test_twin_matches_numpy(self):
+        win, sel, oks, offs, bcol, bval = _mk(257, 12, 14, 4, seed=7)
+        twin = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                     impl="lax")
+        ref = _numpy_ref(win, sel, oks, offs, bcol, bval)
+        np.testing.assert_array_equal(np.asarray(twin), ref)
+
+    def test_zero_and_wrap_offsets(self):
+        n = 1024
+        offs = jnp.asarray([0, 1, n - 1, n, -1, -n, 2 * n - 1,
+                            512, 513, 511, 3, 5, 7, 1023], jnp.int32)
+        win, sel, oks, _, bcol, bval = _mk(n, 12, 14, 4, seed=3)
+        twin = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                     impl="lax")
+        kern = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                     impl="pallas", block_t=256)
+        ref = _numpy_ref(win, sel, oks, offs, bcol, bval)
+        np.testing.assert_array_equal(np.asarray(twin), ref)
+        np.testing.assert_array_equal(np.asarray(kern), ref)
+
+    def test_all_masks_off_is_identity_plus_buddy(self):
+        n, ww = 512, 12
+        win, sel, _, offs, bcol, bval = _mk(n, ww, 14, 4, seed=11)
+        oks = jnp.zeros((14, n), bool)
+        out = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                    impl="pallas", block_t=256)
+        ref = _numpy_ref(win, sel, oks, offs, bcol, bval)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+    def test_traced_offsets(self):
+        """Offsets arrive as traced scalars in the engine (rotor
+        schedule is a function of the traced step)."""
+        n = 1024
+        win, sel, oks, offs, bcol, bval = _mk(n, 12, 14, 4, seed=5)
+
+        @jax.jit
+        def go(offs):
+            return wavemerge.merge_waves(win, sel, oks, offs, bcol,
+                                         bval, impl="pallas",
+                                         block_t=256)
+
+        np.testing.assert_array_equal(
+            np.asarray(go(offs)),
+            np.asarray(wavemerge.merge_waves(win, sel, oks, offs, bcol,
+                                             bval, impl="lax")))
+
+    def test_tiny_n_falls_back(self):
+        win, sel, oks, offs, bcol, bval = _mk(100, 12, 14, 4, seed=9)
+        out = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                    impl="auto")
+        ref = _numpy_ref(win, sel, oks, offs, bcol, bval)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        with pytest.raises(ValueError, match="no viable merge block"):
+            wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                  impl="pallas")

@@ -1,0 +1,262 @@
+"""Anchor the v5e-8 @1M projection with a real shard-sized measurement.
+
+VERDICT r4 Missing #1 / Next #2: the 8-chip 10k-p/s claim rested on a
+model with zero measured anchor points.  This script converts it into a
+projection where EVERY term is measured or trace-derived:
+
+  * **Per-chip HBM term (MEASURED).**  A v5e-8 1M-node run gives each
+    chip N/8 = 131,072 node rows (win/cold shards, node vectors) plus
+    the REPLICATED rumor table of the 1M geometry.  That workload is
+    reproduced on the one real chip as a single-program run at
+    N=131,072 with the timer multipliers re-tuned so `ring.geometry()`
+    yields the EXACT 1M ring (same WW/RW/spread/life — geometry scales
+    with log10 N, so the multipliers must compensate; the solver below
+    matches all four).  Timing uses bench.py's defended harness
+    (distinct seed per dispatch, host-fetch barrier, step-advance
+    proof).
+  * **ICI term (TRACE-DERIVED).**  A CountingOps shim tallies, during
+    one abstract trace of `ring.step` at the FULL 1M size, exactly the
+    bytes the sharded twin (parallel/ring_shard.py ShardOps) would move
+    per chip per period: 2 neighbor-block ppermute transfers per
+    roll_from (upper bound — the k=0 switch branch is free but
+    data-dependent), psum payloads for reductions/replicated gathers,
+    and the [D, kl] candidate all_gather.  Divided by the public v5e
+    per-link ICI bandwidth (45 GB/s per direction; the ring exchange
+    uses one send + one receive link, full duplex).
+
+Projection brackets: perfect HBM/ICI overlap (1/max) vs fully serial
+(1/sum).  Dispatch cost is EXCLUDED from the projection — the ~66 ms
+observed here is the axon tunnel's tax (docs/RESULTS.md §1b #3); an
+on-pod dispatch is local.  Residual approximations, recorded in the
+artifact: the [N]-candidate compactions run at shard size plus a small
+all_gather merge (counted in ICI, its local top_k not re-measured), and
+replicated Phase-D table logic is identical per chip by construction.
+
+Usage: python scripts/shard_anchor.py [--cpu-smoke]
+Artifact: bench_results/shard_anchor_v5e8.json (last stdout line = JSON).
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_FULL = 1_000_000
+D = 8
+N_SHARD = N_FULL // D
+PERIODS = 100
+
+ICI_GBPS = 45.0          # v5e ICI, per link per direction (public figure)
+NORTH_STAR_PPS = 10_000.0
+
+ARMS = {
+    "ringp": dict(ring_sel_scope="period"),
+    "lean": dict(ring_sel_scope="period", suspicion_mult=2.0,
+                 retransmit_mult=2.0, k_indirect=1,
+                 ring_window_periods=3, ring_view_c=2),
+}
+
+
+def _match_mult(base: float, want: "dict[float, int]") -> float:
+    """Smallest multiplier m >= candidates near `base` such that every
+    ceil(m * key) == value in `want` (keys are log-N-scaled factors)."""
+    for i in range(0, 400):
+        m = round(base + i * 0.005, 4)
+        if all(math.ceil(m * k) == v for k, v in want.items()):
+            return m
+    raise RuntimeError(f"no multiplier matches {want} near {base}")
+
+
+def matched_cfg(kw: dict):
+    """SwimConfig at N_SHARD whose ring geometry & timers equal the
+    N_FULL config's (per-chip slice of the 1M run carries the 1M ring)."""
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import ring
+
+    full = SwimConfig(n_nodes=N_FULL, **kw)
+    ln = SwimConfig(n_nodes=N_SHARD, **kw).log_n
+    rm = _match_mult(full.retransmit_mult,
+                     {ln: full.retransmit_limit})
+    sm = _match_mult(full.suspicion_mult,
+                     {ln: full.suspicion_periods,
+                      ln * full.suspicion_max_mult:
+                          full.suspicion_max_periods})
+    cfg = SwimConfig(n_nodes=N_SHARD,
+                     **{**kw, "retransmit_mult": rm, "suspicion_mult": sm})
+    gf, gs = ring.geometry(full), ring.geometry(cfg)
+    if gf != gs:
+        raise RuntimeError(f"geometry mismatch: full={gf} shard={gs}")
+    assert cfg.suspicion_periods == full.suspicion_periods
+    assert cfg.gossip_window == full.gossip_window
+    return cfg, full
+
+
+def trace_ici_bytes(full_cfg) -> dict:
+    """Per-chip ICI bytes/period the ShardOps layout would move at
+    N_FULL over D chips — tallied by shimming the ops seam during one
+    abstract (eval_shape) trace of the real step body."""
+    import jax
+    import jax.numpy as jnp
+
+    from swim_tpu.models import ring
+    from swim_tpu.sim import faults
+
+    tally: dict[str, int] = {}
+
+    def add(key, nbytes):
+        tally[key] = tally.get(key, 0) + int(nbytes)
+
+    class CountingOps(ring.GlobalOps):
+        def __init__(self, cfg, d):
+            super().__init__(cfg)
+            self.d = d
+
+        def roll_from(self, x, dd):
+            add(f"roll[{'x'.join(map(str, x.shape))},{x.dtype}]",
+                2 * x.size * x.dtype.itemsize // self.d)
+            return super().roll_from(x, dd)
+
+        def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
+            add("roll_sel_waves",
+                len(oks) * 2 * sel.size * sel.dtype.itemsize // self.d)
+            return super().merge_waves(win, sel, oks, offs, bcols,
+                                       bvals, impl="lax")
+
+        def gsum(self, partial):
+            add("psum_scalar",
+                4 * getattr(partial, "size", 1))
+            return super().gsum(partial)
+
+        def gather(self, arr, idx):
+            add("gather_psum", 4 * max(getattr(idx, "size", 1), 1))
+            return super().gather(arr, idx)
+
+        def knows_words(self, win, cold, slot_pos, rows, slot):
+            add("knows_psum", 4 * max(getattr(slot, "size", 1), 1))
+            return super().knows_words(win, cold, slot_pos, rows, slot)
+
+        def first_true_nodes(self, valid, k):
+            kl = min(k, self.n // self.d)
+            add("candidates_all_gather", 4 * self.d * kl)
+            return super().first_true_nodes(valid, k)
+
+    ops_c = CountingOps(full_cfg, D)
+
+    def one_period():
+        st = ring.init_state(full_cfg)
+        plan = faults.none(full_cfg.n_nodes)
+        rnd = ring.draw_period_ring(jax.random.key(0), jnp.int32(0),
+                                    full_cfg)
+        return ring.step(full_cfg, st, plan, rnd, ops=ops_c)
+
+    jax.eval_shape(one_period)
+    total = sum(tally.values())
+    return {"per_chip_bytes_per_period": total,
+            "t_ici_ms": total / (ICI_GBPS * 1e9) * 1e3,
+            "breakdown": dict(sorted(tally.items(),
+                                     key=lambda kv: -kv[1]))}
+
+
+def measure_chip(cfg) -> dict:
+    """Measured per-chip HBM term: the shard-sized workload on the real
+    chip (bench.py defended harness)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _time_run
+    from swim_tpu.models import ring
+    from swim_tpu.sim import faults
+    from swim_tpu.utils import roofline as rl
+
+    n = cfg.n_nodes
+    plan = faults.with_random_crashes(
+        faults.none(n), jax.random.key(1), 0.001, 0, PERIODS)
+    state = ring.init_state(cfg)
+    key = jax.random.key(0)
+    run = jax.jit(lambda st, seed: ring.run(
+        cfg, st, plan, jax.random.fold_in(key, seed), PERIODS))
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(state, jnp.int32(99)))
+    compile_s = time.perf_counter() - t0
+    pps = _time_run(run, state, warmup=1, periods=PERIODS)
+    ceil = rl.ceiling_periods_per_sec(cfg)
+    if pps > 3.0 * ceil["ceiling_fused"]:
+        raise RuntimeError(f"{pps:.0f} p/s exceeds 3x roofline — timing "
+                           "artifact")
+    return {"n": n, "periods": PERIODS, "periods_per_sec": round(pps, 2),
+            "t_chip_ms": round(1e3 / pps, 3),
+            "compile_s": round(compile_s, 1),
+            "ceiling_fused_pps": round(ceil["ceiling_fused"], 1),
+            "platform": jax.devices()[0].platform}
+
+
+def main() -> int:
+    import jax
+
+    from swim_tpu.models import ring
+
+    smoke = "--cpu-smoke" in sys.argv
+    if smoke:
+        from swim_tpu.utils.platform import force_cpu
+
+        force_cpu(1)
+    arms = {}
+    for name, kw in ARMS.items():
+        cfg, full = matched_cfg(kw)
+        g = ring.geometry(cfg)
+        ici = trace_ici_bytes(full)
+        chip = measure_chip(cfg)
+        t_chip = chip["t_chip_ms"]
+        t_ici = ici["t_ici_ms"]
+        arms[name] = {
+            "geometry": {"ww": g.ww, "rw": g.rw, "c": g.c,
+                         "k": cfg.k_indirect,
+                         "suspicion_mult_matched": cfg.suspicion_mult,
+                         "retransmit_mult_matched": cfg.retransmit_mult},
+            "chip_measured": chip,
+            "ici_traced": ici,
+            "projected_v5e8_pps_overlap": round(
+                1e3 / max(t_chip, t_ici), 1),
+            "projected_v5e8_pps_serial": round(
+                1e3 / (t_chip + t_ici), 1),
+        }
+        print(json.dumps({name: arms[name]}), flush=True)
+    out = {
+        "study": "shard_anchor_v5e8",
+        "n_full": N_FULL, "devices": D, "n_shard": N_SHARD,
+        "ici_gbps_per_link": ICI_GBPS,
+        "north_star_pps": NORTH_STAR_PPS,
+        "platform": jax.devices()[0].platform,
+        "arms": arms,
+        "notes": [
+            "per-chip term MEASURED on one real chip at N=131072 with "
+            "timer multipliers matched so ring.geometry equals the 1M "
+            "config's (per-chip slice of a v5e-8 1M run)",
+            "ICI term trace-derived from the ops seam: 2 neighbor-block "
+            "transfers per roll (upper bound: the k=0 switch branch is "
+            "free), psum/all_gather payloads counted at result size",
+            "dispatch excluded: the ~66 ms/dispatch here is the axon "
+            "tunnel tax; on-pod dispatch is local",
+            "north-star verdict = projected lean arm vs 10,000 p/s",
+        ],
+    }
+    ns = arms.get("lean", arms.get("ringp"))
+    out["north_star_within_overlap_projection"] = bool(
+        ns and ns["projected_v5e8_pps_overlap"] >= NORTH_STAR_PPS)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench_results",
+        "shard_anchor_v5e8.json")
+    if not smoke:
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path}", file=sys.stderr)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

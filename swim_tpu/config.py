@@ -105,6 +105,20 @@ class SwimConfig:
     #                              elsewhere; "pallas"/"lax" force one
     #                              path (pallas runs interpreted off-TPU
     #                              — tests pin the two bitwise-equal).
+    ring_wave_kernel: str = "auto"  # fused wave-OR merge path (rotor +
+    #                              ring_sel_scope="period" only): all
+    #                              2+4k delivery ORs of the period run
+    #                              as ONE pass (ops/wavemerge.py).
+    #                              "auto" uses the Pallas kernel on the
+    #                              TPU backend (contiguous-DMA rolls in
+    #                              the transposed window view) and the
+    #                              rolled-OR jnp lowering elsewhere;
+    #                              "pallas"/"lax" force one path (pallas
+    #                              runs interpreted off-TPU — tests pin
+    #                              the two bitwise-equal).  Inert in
+    #                              "wave" scope (per-wave re-selection
+    #                              reads the live window, so the waves
+    #                              cannot be fused) and in pull mode.
 
     def __post_init__(self):
         if self.n_nodes < 2:
@@ -121,6 +135,20 @@ class SwimConfig:
         if self.ring_selb_kernel not in ("auto", "pallas", "lax"):
             raise ValueError(
                 f"bad ring_selb_kernel {self.ring_selb_kernel!r}")
+        if self.ring_wave_kernel not in ("auto", "pallas", "lax"):
+            raise ValueError(
+                f"bad ring_wave_kernel {self.ring_wave_kernel!r}")
+        if self.ring_wave_kernel == "pallas" and not (
+                self.ring_probe == "rotor"
+                and self.ring_sel_scope == "period"):
+            raise ValueError(
+                "ring_wave_kernel='pallas' requires ring_probe='rotor' "
+                "and ring_sel_scope='period': only the period-scope "
+                "rotor path fuses its waves (wave scope re-selects from "
+                "the live window before every wave, so its deliveries "
+                "cannot merge into one pass) — a forced-pallas run "
+                "elsewhere would silently use the per-wave path (use "
+                "'auto' or 'lax')")
         if self.ring_cold_kernel == "pallas" and self.ring_probe != "rotor":
             raise ValueError(
                 "ring_cold_kernel='pallas' requires ring_probe='rotor': "

@@ -106,7 +106,7 @@ import jax
 import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
-from swim_tpu.ops import coldsel, lattice, sampling, selb
+from swim_tpu.ops import coldsel, lattice, sampling, selb, wavemerge
 from swim_tpu.sim.faults import FaultPlan
 
 WORD = 32
@@ -687,6 +687,30 @@ class GlobalOps:
         node-axis bool vector; missing entries fill with n."""
         return _first_true_idx(valid, k)
 
+    def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
+        """Fused period-scope delivery: OR the rolled start-of-period
+        selection payload into `win` under each wave's receiver mask,
+        plus the compact buddy forced bits, in one pass.
+
+        oks/offs are per-wave lists ([N] bool / traced scalar d, with
+        receiver i hearing sel row (i + d) mod n); bcols/bvals are
+        receiver-aligned compact forced-bit lists (val 0 = inert).
+        This layout routes to ops/wavemerge.py (Pallas kernel on the
+        TPU backend: the lane-misaligned rolled ORs become contiguous
+        DMAs — the largest profiled term of the 1M period, 2.33 ms,
+        docs/RESULTS.md §1); the sharded twin keeps per-wave ppermute
+        rolls (same values, same ICI traffic either way)."""
+        if bcols:
+            bcol = jnp.stack(bcols)
+            bval = jnp.stack(bvals)
+        else:
+            bcol = jnp.zeros((0, self.n), jnp.int32)
+            bval = jnp.zeros((0, self.n), jnp.uint32)
+        return wavemerge.merge_waves(
+            win, sel, jnp.stack(oks),
+            jnp.stack([jnp.asarray(d, jnp.int32) for d in offs]),
+            bcol, bval, impl=impl)
+
 
 def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
          rnd: RingRandomness, ops: GlobalOps | None = None,
@@ -944,23 +968,32 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # _col_select_multi's docstring for the measured cost gap.)
         prober = active & roll_from(joined, s_off)
 
-        def buddy_bits(d):
-            """u32[N, WW]: forced window bit of the suspect witness about
-            subject (i + d) mod n, when sender i knows it and it is in
-            the window.  Subject-table lookups are rolls; the sender's
-            own word is a streamed window column-select (window-only:
-            the result is masked by in_win, so cold never matters)."""
+        def buddy_cv(d):
+            """Compact (col i32[N], val u32[N]): forced window bit of the
+            suspect witness about subject (i + d) mod n, when sender i
+            knows it and it is in the window (val 0 = inert).
+            Subject-table lookups are rolls; the sender's own word is a
+            streamed window column-select (window-only: val is masked by
+            in_win, so cold never matters)."""
             if not (cfg.lifeguard and cfg.buddy):
-                return no_force
+                return None
             slot = roll_from(sus_slot, d)
             in_win, wcol, _, bit = slot_pos(slot)
             (wword,) = _col_select_multi(sel_win(), [wcol])
             kn = (slot >= 0) & (((wword >> bit) & 1) > 0)
             usebit = kn & in_win
-            onehot_w = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]
-                        == wcol[:, None])
-            return jnp.where(usebit[:, None] & onehot_w,
-                             (jnp.uint32(1) << bit)[:, None], jnp.uint32(0))
+            return wcol, jnp.where(usebit, jnp.uint32(1) << bit,
+                                   jnp.uint32(0))
+
+        def force_mat(cv):
+            """[N, WW] one-hot expansion of a compact forced bit — the
+            per-wave (unfused) delivery path's sel contribution."""
+            if cv is None:
+                return no_force
+            col, val = cv
+            onehot = (jnp.arange(g.ww, dtype=jnp.int32)[None, :]
+                      == col[:, None])
+            return jnp.where(onehot, val[:, None], jnp.uint32(0))
 
         def wave_ok(send_flag_at_sender, d, u):
             """bool[N] per receiver i: the message from (i+d) arrived."""
@@ -968,17 +1001,32 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                     & ~(part_on & (roll_from(pid, d) != pid))
                     & (u >= loss_thr))
 
+        # Period scope: every wave ORs the SAME start-of-period selection
+        # (sel_base | forced) into the window, and the ok chain never
+        # reads the window — so the 2+4k delivery ORs commute and fuse
+        # into ONE merge pass (ops/wavemerge.py; ≤32 waves per its u32
+        # ok-pack).  Wave scope re-selects from the live window before
+        # every wave, so deliveries must stay in-line.
+        fused = period_scope and (2 + 4 * k) <= 32
+        waves = []              # (ok, off, compact buddy cv | None)
+
+        def deliver(ok, d, cv=None):
+            """One wave: receiver i ORs sel row (i + d) mod n under ok."""
+            nonlocal win
+            if fused:
+                waves.append((ok, d, cv))
+            else:
+                sel_w = sel_now(force_mat(cv))
+                win = win | jnp.where(ok[:, None], roll_from(sel_w, d),
+                                      jnp.uint32(0))
+
         # W1: ping i -> i+s.  Receiver j hears from sender j−s.
-        sel1 = sel_now(buddy_bits(s_off))
         ok1 = wave_ok(prober & active, -s_off, rnd.loss_w1)  # per recv j
-        win = win | jnp.where(ok1[:, None], roll_from(sel1, -s_off),
-                              jnp.uint32(0))
+        deliver(ok1, -s_off, buddy_cv(s_off))
         # W2: ack j=i+s -> i (acks iff the ping arrived; ok1 is indexed
         # by j already).  Receiver i hears from i+s.
-        sel2 = sel_now(no_force)
         ok2 = wave_ok(ok1, s_off, rnd.loss_w2)               # per recv i
-        win = win | jnp.where(ok2[:, None], roll_from(sel2, s_off),
-                              jnp.uint32(0))
+        deliver(ok2, s_off)
         acked = ok2 & prober
 
         need = prober & ~acked
@@ -987,29 +1035,39 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             q = rnd.q_off[a]
             d4 = s_off - q
             # W3: ping-req i -> i+q.  Receiver p hears from p−q.
-            sel3 = sel_now(no_force)
             ok3 = wave_ok(need, -q, rnd.loss_w3[:, a])       # per recv p
-            win = win | jnp.where(ok3[:, None], roll_from(sel3, -q),
-                                  jnp.uint32(0))
+            deliver(ok3, -q)
             # W4: proxy ping p -> p+d4 (the original target j=i+s).
             # Receiver j hears from j−d4 = p.
-            sel4 = sel_now(buddy_bits(d4))
             ok4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a])       # per recv j
-            win = win | jnp.where(ok4[:, None], roll_from(sel4, -d4),
-                                  jnp.uint32(0))
+            deliver(ok4, -d4, buddy_cv(d4))
             # W5: target ack j -> j−d4 (back to proxy p).  Receiver p
             # hears from p+d4.
-            sel5 = sel_now(no_force)
             ok5 = wave_ok(ok4, d4, rnd.loss_w5[:, a])        # per recv p
-            win = win | jnp.where(ok5[:, None], roll_from(sel5, d4),
-                                  jnp.uint32(0))
+            deliver(ok5, d4)
             # W6: relay ack p -> p−q (back to prober i).  Receiver i
             # hears from i+q.
-            sel6 = sel_now(no_force)
             ok6 = wave_ok(ok5, q, rnd.loss_w6[:, a])         # per recv i
-            win = win | jnp.where(ok6[:, None], roll_from(sel6, q),
-                                  jnp.uint32(0))
+            deliver(ok6, q)
             relayed = relayed | (ok6 & need)
+
+        if fused:
+            # Buddy forced bits ride as receiver-aligned compact rows:
+            # roll the sender-side (col, val) by the wave's offset and
+            # mask val by the wave's delivery (roll of sel|forced ==
+            # roll(sel) | roll(forced), bit-OR exact).
+            bcols, bvals = [], []
+            for ok, d, cv in waves:
+                if cv is None:
+                    continue
+                col, val = cv
+                bcols.append(roll_from(col, d))
+                bvals.append(jnp.where(ok, roll_from(val, d),
+                                       jnp.uint32(0)))
+            win = ops.merge_waves(
+                win, sel_base, [w[0] for w in waves],
+                [w[1] for w in waves], bcols, bvals,
+                impl=cfg.ring_wave_kernel)
 
         probe_ok = acked | relayed
         failed = prober & ~probe_ok

@@ -55,16 +55,18 @@ def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _block_t(v: int, ww: int, n: int) -> int:
+def _block_t(v: int, vb: int, ww: int, n: int) -> int:
     """Node-axis block width (lanes).
 
     VMEM is dominated by the V per-wave selection buffers ([V, WW, T]
-    u32) plus the accumulator; budget ~8 MB for them, keep T a
-    128-lane multiple, and cap at 8192 (123 blocks at the 1M
-    flagship: DMA issue overhead amortizes, transfers overlap).
-    Returns 0 when no 128-wide block fits the budget or when n is too
-    small to clamp against (the twin handles those)."""
-    budget = (8 * 1024 * 1024) // ((v + 1) * ww * 4)
+    u32) plus the accumulator ([WW, T]), the ok bits ([1, T]) and the
+    buddy col/val scratch ([VB, T] ×2) — (V+1)·WW + 1 + 2·VB words per
+    lane; budget ~8 MB for them, keep T a 128-lane multiple, and cap
+    at 8192 (123 blocks at the 1M flagship: DMA issue overhead
+    amortizes, transfers overlap).  Returns 0 when no 128-wide block
+    fits the budget or when n is too small to clamp against (the twin
+    handles those)."""
+    budget = (8 * 1024 * 1024) // (((v + 1) * ww + 1 + 2 * vb) * 4)
     t = min(8192, (budget // 128) * 128, (n // 128) * 128)
     return t if t >= 128 and n >= t else 0
 
@@ -202,7 +204,14 @@ def merge_waves(win, sel, oks, offs, bcol, bval, impl: str = "auto",
     if impl == "lax" or (impl == "auto"
                          and jax.default_backend() != "tpu"):
         return _lax_twin(win, sel, oks, offs, bcol, bval)
-    t = block_t if block_t is not None else _block_t(v, ww, n)
+    if bcol.shape[0] == 0:
+        # A zero-row VMEM scratch is not a valid Mosaic allocation;
+        # one inert row (val 0 contributes nothing) keeps the kernel
+        # shape-uniform for buddy-less configs.
+        bcol = jnp.zeros((1, n), jnp.int32)
+        bval = jnp.zeros((1, n), jnp.uint32)
+    vb = int(bcol.shape[0])
+    t = block_t if block_t is not None else _block_t(v, vb, ww, n)
     if t == 0:
         # No viable block: tiny N (< one 128-lane tile) or a
         # VMEM-hostile geometry.  Block STARTS need no alignment —

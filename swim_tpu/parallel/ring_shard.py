@@ -251,6 +251,25 @@ class ShardOps:
         return jax.lax.psum(
             jnp.where(owned, kn, False).astype(jnp.int32), AXIS) > 0
 
+    def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
+        """GlobalOps.merge_waves twin: same values for this shard's
+        rows.  The fused Pallas kernel needs the whole node axis in one
+        address space; here every wave's roll is already the
+        two-ppermute neighbor exchange, so the merge stays per-wave —
+        the ICI traffic is identical either way (one sel-window payload
+        per wave), and `impl` is a single-program concern."""
+        del impl
+        zero = jnp.zeros((), jnp.uint32)
+        out = win
+        for ok, d in zip(oks, offs):
+            out = out | jnp.where(ok[:, None], self.roll_from(sel, d),
+                                  zero)
+        wids = jnp.arange(win.shape[1], dtype=jnp.int32)[None, :]
+        for col, val in zip(bcols, bvals):
+            out = out | jnp.where(col[:, None] == wids, val[:, None],
+                                  zero)
+        return out
+
     def first_true_nodes(self, valid, k):
         # per-shard sort-free compaction (ring._first_true_idx), then a
         # small all-gather + merge of the D candidate lists — the merge

@@ -126,3 +126,48 @@ class TestKernelVsTwin:
         with pytest.raises(ValueError, match="no viable merge block"):
             wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
                                   impl="pallas")
+
+    def test_no_buddy_rows(self):
+        """vb=0 (buddy off / vanilla configs): the kernel pads one inert
+        row rather than allocating zero-row VMEM scratch."""
+        win, sel, oks, offs, _, _ = _mk(512, 12, 14, 1, seed=13)
+        bcol = jnp.zeros((0, 512), jnp.int32)
+        bval = jnp.zeros((0, 512), jnp.uint32)
+        ref = _numpy_ref(win, sel, oks, offs, bcol, bval)
+        out = wavemerge.merge_waves(win, sel, oks, offs, bcol, bval,
+                                    impl="pallas", block_t=256)
+        np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+class TestEngineIntegration:
+    """The kernel wired into ring.step (period scope, rotor): the
+    forced-pallas engine must be bitwise-equal to the forced-lax engine
+    over a full crash-lifecycle run — the integration contract on top of
+    the op-level twin tests above (VERDICT r4 Next #1)."""
+
+    def _run(self, kernel: str, lifeguard: bool):
+        import jax
+
+        from swim_tpu.config import SwimConfig
+        from swim_tpu.models import ring
+        from swim_tpu.sim import faults
+
+        n = 256
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         ring_wave_kernel=kernel, lifeguard=lifeguard)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 77], [2, 4]), 0.1)
+        key = jax.random.key(23)
+        st = ring.init_state(cfg)
+        step = jax.jit(lambda s, r: ring.step(cfg, s, plan, r),
+                       static_argnames=())
+        for t in range(12):
+            st = step(st, ring.draw_period_ring(key, t, cfg))
+        return st
+
+    @pytest.mark.parametrize("lifeguard", [False, True])
+    def test_engine_bitwise(self, lifeguard):
+        a = self._run("lax", lifeguard)
+        b = self._run("pallas", lifeguard)
+        for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))

@@ -43,12 +43,14 @@ import argparse
 import functools
 import json
 import os
+import re
 import subprocess
 import sys
 import time
 
 TARGET_PERIODS_PER_SEC = 10_000.0
 CPU_FALLBACK_DEVICES = 8
+HEADLINE_MIN_NODES = 1_000_000
 
 
 # --------------------------------------------------------------------------
@@ -204,7 +206,7 @@ def is_headline_run(on_tpu: bool, head: dict | None, smoke: bool,
     would over- or under-sell the build — the exact failure the record
     exists to prevent)."""
     return (on_tpu and head is not None and not smoke
-            and head.get("nodes", 0) >= 1_000_000
+            and head.get("nodes", 0) >= HEADLINE_MIN_NODES
             and head.get("periods", 0) >= 25
             and head.get("platform_actual") == "tpu"
             and "backend_died_after" not in info)
@@ -219,6 +221,43 @@ def load_last_good_tpu() -> dict | None:
         return rec
     except Exception:  # noqa: BLE001
         return None
+
+
+_METRIC_NODES_RE = re.compile(r"@ (\d+) nodes")
+
+
+def promote_headline(lg: dict | None) -> dict | None:
+    """The single defended record a CPU-fallback line may promote to
+    the top-level headline_tpu_* keys.
+
+    `bests` is deliberately keyed per metric string (nodes/engine/
+    probe/scope all pin the key), so a bare max() over its values
+    ranks captures of DIFFERENT experiments against each other — a
+    smaller-N or leaner-config record with a flashier periods/sec
+    would outrank the flagship 1M capture and misreport the build
+    (ADVICE r5).  Promotion is therefore pinned: only bests whose
+    metric string names a flagship-scale run (>= HEADLINE_MIN_NODES
+    parsed from its "@ N nodes" clause — the same floor
+    is_headline_run defends at capture time) compete; with none on
+    record, fall back to the latest capture's own single-metric
+    `best`.  Never a cross-metric max."""
+    if not isinstance(lg, dict):
+        return None
+
+    def _ok(c):
+        return (isinstance(c, dict)
+                and isinstance(c.get("value"), (int, float)))
+
+    def _nodes(c):
+        m = _METRIC_NODES_RE.search(str(c.get("metric", "")))
+        return int(m.group(1)) if m else 0
+
+    flagship = [c for c in (lg.get("bests") or {}).values()
+                if _ok(c) and _nodes(c) >= HEADLINE_MIN_NODES]
+    if flagship:
+        return max(flagship, key=lambda c: c["value"])
+    best = lg.get("best")
+    return best if _ok(best) else None
 
 
 # --------------------------------------------------------------------------
@@ -394,10 +433,14 @@ def bench_shard(n_nodes: int, periods: int, warmup: int = 1,
 
 
 def bench_ring_shard(n_nodes: int, periods: int, warmup: int = 2,
-                     crash_fraction: float = 0.001) -> float:
+                     crash_fraction: float = 0.001,
+                     ring_sel_scope: str = "wave",
+                     ring_ici_wire: str = "window") -> float:
     """Explicitly-sharded ring engine (shard_map + ppermute rolls) —
     the production multi-chip path; on one chip it degenerates to the
-    plain ring step."""
+    plain ring step.  The 'ringshardc' tier is this same harness with
+    ring_sel_scope='period' + ring_ici_wire='compact' (the bounded-
+    piggyback ICI wire — the multi-chip throughput configuration)."""
     import jax
 
     from swim_tpu import SwimConfig
@@ -405,7 +448,8 @@ def bench_ring_shard(n_nodes: int, periods: int, warmup: int = 2,
     from swim_tpu.parallel import mesh as pmesh, ring_shard
     from swim_tpu.sim import faults
 
-    cfg = SwimConfig(n_nodes=n_nodes)
+    cfg = SwimConfig(n_nodes=n_nodes, ring_sel_scope=ring_sel_scope,
+                     ring_ici_wire=ring_ici_wire)
     mesh = pmesh.make_mesh()
     plan = faults.with_random_crashes(
         faults.none(n_nodes), jax.random.key(1), crash_fraction,
@@ -424,7 +468,20 @@ TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
             "ringp": functools.partial(bench_ring,
                                        ring_sel_scope="period"),
-            "ringshard": bench_ring_shard}
+            "ringshard": bench_ring_shard,
+            "ringshardc": functools.partial(bench_ring_shard,
+                                            ring_sel_scope="period",
+                                            ring_ici_wire="compact")}
+
+# ring-family tiers: the SwimConfig knobs each one benches, shared by
+# the tier body (via TIER_FNS partials) and the child's self-describing
+# report so the two can never drift
+RING_TIER_CFGS = {
+    "ring": {},
+    "ringp": {"ring_sel_scope": "period"},
+    "ringshard": {},
+    "ringshardc": {"ring_sel_scope": "period", "ring_ici_wire": "compact"},
+}
 
 
 def run_tier_child(args) -> int:
@@ -448,18 +505,18 @@ def run_tier_child(args) -> int:
                # must not trust its own request label (a 'default'
                # platform can silently be CPU on a CPU-default host)
                "platform_actual": jax.devices()[0].platform}
-        if args._tier in ("ring", "ringp", "ringshard"):
+        if args._tier in RING_TIER_CFGS:
             # Self-describing headline (VERDICT r2 task 7): report probe
-            # mode and the HBM roofline band so a green number can never
-            # hide a rotor-vs-pull or CPU-vs-TPU apples-to-oranges read.
+            # mode, sel scope, ICI wire and the HBM roofline band so a
+            # green number can never hide a rotor-vs-pull, wire-format,
+            # or CPU-vs-TPU apples-to-oranges read.
             from swim_tpu import SwimConfig
             from swim_tpu.utils import roofline as rl
 
-            cfg = SwimConfig(
-                n_nodes=args.nodes,
-                ring_sel_scope=("period" if args._tier == "ringp"
-                                else "wave"))
+            cfg = SwimConfig(n_nodes=args.nodes,
+                             **RING_TIER_CFGS[args._tier])
             out["ring_sel_scope"] = cfg.ring_sel_scope
+            out["ring_ici_wire"] = cfg.ring_ici_wire
             ceil = rl.ceiling_periods_per_sec(cfg)
             out["devices"] = len(jax.devices())
             # Physical-plausibility guard: the step is HBM-bound, so a
@@ -528,7 +585,8 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--tier", default="flagship",
                     choices=("dense", "rumor", "shard", "ring", "ringp",
-                             "ringshard", "flagship", "both", "all"))
+                             "ringshard", "ringshardc", "flagship",
+                             "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
     ap.add_argument("--platform", default="auto",
@@ -587,7 +645,8 @@ def main() -> int:
     tiers = {"flagship": ["ring", "ringp", "ringshard"],
              "both": ["dense", "ring"],
              "all": ["dense", "rumor", "shard", "ring", "ringp",
-                     "ringshard"]}.get(args.tier, [args.tier])
+                     "ringshard", "ringshardc"]}.get(args.tier,
+                                                     [args.tier])
     results = {}
     backend_dead = False
     for tier in tiers:
@@ -628,7 +687,8 @@ def main() -> int:
     # scalable tier succeeded — its small-N exact-engine pps is not
     # comparable to the 1M-node target.
     head_tier, head = None, None
-    for tier in ("ring", "ringp", "ringshard", "shard", "rumor"):
+    for tier in ("ring", "ringp", "ringshard", "ringshardc", "shard",
+                 "rumor"):
         r = results.get(tier)
         if r and r.get("ok"):
             if head is None or r["periods_per_sec"] > head["periods_per_sec"]:
@@ -641,8 +701,11 @@ def main() -> int:
                      if head.get("ring_probe") else "")
         scope_txt = ("period-sel, "
                      if head.get("ring_sel_scope") == "period" else "")
+        wire_txt = ("compact-ici, "
+                    if head.get("ring_ici_wire") == "compact" else "")
         metric = (f"simulated protocol-periods/sec @ {head['nodes']} nodes "
-                  f"({head_tier} engine, {probe_txt}{scope_txt}{platform})")
+                  f"({head_tier} engine, {probe_txt}{scope_txt}{wire_txt}"
+                  f"{platform})")
     else:
         value = 0.0
         metric = f"simulated protocol-periods/sec (all tiers failed, {platform})"
@@ -658,6 +721,7 @@ def main() -> int:
     if head is not None and head.get("v5e_chip_ceiling_pps"):
         out["ring_probe"] = head["ring_probe"]
         out["ring_sel_scope"] = head.get("ring_sel_scope", "wave")
+        out["ring_ici_wire"] = head.get("ring_ici_wire", "window")
         out["v5e_chip_ceiling_pps"] = head["v5e_chip_ceiling_pps"]
         out["bytes_per_period"] = head["bytes_per_period"]
         if on_tpu:
@@ -693,11 +757,8 @@ def main() -> int:
             # commit rides along (ADVICE r4: a best captured on older
             # code must be distinguishable from the current commit's
             # measurement, or regressions hide behind the best).
-            cands = [c for c in (lg.get("bests") or {}).values()
-                     if isinstance(c, dict)
-                     and isinstance(c.get("value"), (int, float))]
-            if cands:
-                top = max(cands, key=lambda c: c["value"])
+            top = promote_headline(lg)
+            if top is not None:
                 out["headline_tpu_value"] = top["value"]
                 out["headline_tpu_metric"] = top.get("metric")
                 out["headline_tpu_commit"] = top.get("commit", "unknown")

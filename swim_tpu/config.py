@@ -119,6 +119,23 @@ class SwimConfig:
     #                              "wave" scope (per-wave re-selection
     #                              reads the live window, so the waves
     #                              cannot be fused) and in pull mode.
+    ring_ici_wire: str = "window"  # sharded wave-exchange payload
+    #                              (parallel/ring_shard.py; inert in the
+    #                              single-program engine, which has no
+    #                              wire). "window" ships each wave's
+    #                              full sel window u32[S, WW] (two
+    #                              neighbor blocks per wave). "compact"
+    #                              ships SWIM's bounded piggyback
+    #                              instead: each sel row carries at most
+    #                              B = max_piggyback set bits (first-B
+    #                              selection), so rows pack into B slot
+    #                              indices (ops/wavepack.py) and each
+    #                              wave moves ONE packed neighbor block
+    #                              — bitwise-equal, ~WW*32/B fewer ICI
+    #                              bytes. Requires the fused rotor
+    #                              period-scope path (sel is selected
+    #                              once per period; wave scope re-packs
+    #                              per wave and pull mode has no waves).
 
     def __post_init__(self):
         if self.n_nodes < 2:
@@ -149,6 +166,35 @@ class SwimConfig:
                 "cannot merge into one pass) — a forced-pallas run "
                 "elsewhere would silently use the per-wave path (use "
                 "'auto' or 'lax')")
+        if self.ring_wave_kernel == "pallas" and (
+                2 + 4 * self.k_indirect > 32):
+            raise ValueError(
+                f"ring_wave_kernel='pallas' is impossible at k_indirect="
+                f"{self.k_indirect}: the fused wave merge packs the "
+                f"period's 2+4k={2 + 4 * self.k_indirect} wave-ok bits "
+                "into one u32 lane mask (ops/wavemerge.py), so only "
+                "k_indirect <= 7 can fuse — a forced-pallas run here "
+                "would silently fall back to the per-wave path (use "
+                "'auto' or 'lax', or lower k_indirect)")
+        if self.ring_ici_wire not in ("window", "compact"):
+            raise ValueError(f"bad ring_ici_wire {self.ring_ici_wire!r}")
+        if self.ring_ici_wire == "compact":
+            if not (self.ring_probe == "rotor"
+                    and self.ring_sel_scope == "period"):
+                raise ValueError(
+                    "ring_ici_wire='compact' requires ring_probe='rotor' "
+                    "and ring_sel_scope='period': the compact wire packs "
+                    "the ONE per-period first-B selection and replays it "
+                    "for every wave — wave scope re-selects from the "
+                    "live window before each wave (nothing to pack once) "
+                    "and pull mode delivers by gather, not waves")
+            if 2 + 4 * self.k_indirect > 32:
+                raise ValueError(
+                    f"ring_ici_wire='compact' is impossible at "
+                    f"k_indirect={self.k_indirect}: it rides the fused "
+                    f"period-scope merge, whose 2+4k="
+                    f"{2 + 4 * self.k_indirect} wave-ok bits must pack "
+                    "into one u32 lane mask (k_indirect <= 7)")
         if self.ring_cold_kernel == "pallas" and self.ring_probe != "rotor":
             raise ValueError(
                 "ring_cold_kernel='pallas' requires ring_probe='rotor': "

@@ -1006,7 +1006,12 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # reads the window — so the 2+4k delivery ORs commute and fuse
         # into ONE merge pass (ops/wavemerge.py; ≤32 waves per its u32
         # ok-pack).  Wave scope re-selects from the live window before
-        # every wave, so deliveries must stay in-line.
+        # every wave, so deliveries must stay in-line.  The single
+        # merge_waves call is also the sharded twin's ICI wire seam:
+        # with cfg.ring_ici_wire="compact", ShardOps ships sel_base as
+        # packed B-slot indices per wave instead of the dense window
+        # (SWIM's bounded piggyback on the wire — ops/wavepack.py);
+        # inert here, where the whole node axis is one address space.
         fused = period_scope and (2 + 4 * k) <= 32
         waves = []              # (ok, off, compact buddy cv | None)
 

@@ -1,0 +1,107 @@
+"""Slot-index codec for the compact ICI wave wire (ring_ici_wire).
+
+SWIM's dissemination is bounded piggyback (Das et al., DSN 2002 §4.1):
+each message carries at most B membership updates.  The ring engine
+honors that bound at selection time — `_select_first_b` leaves at most
+B = min(max_piggyback, WW*32) set bits per sel row — but the sharded
+wave exchange (parallel/ring_shard.py) then ships the whole dense
+window u32[S, WW] over ICI, paying for WW*32 slot positions per row
+when at most B are live.
+
+This module packs a bounded-piggyback sel block into its information
+content: the SLOT INDICES of the set bits, row-major first-to-last,
+
+    pack_slots(sel u32[S, WW], b)  ->  idx[S, b]   (uint8 or uint16)
+
+where slot = word_col * 32 + bit, empty entries hold the dtype's max
+value as a sentinel (a real slot never reaches it — see slot_dtype),
+and
+
+    unpack_slots(idx, ww)  ->  u32[S, WW]
+
+reconstructs the exact window block (the values are single bits, so
+they need not travel: receiver-side `1 << (slot & 31)` rebuilds them).
+`unpack_slots(pack_slots(sel, b), ww) == sel` bitwise whenever every
+row of `sel` has at most b set bits — which first-B selection
+guarantees by construction.  Both directions are scatter-free
+(extract-lowest-bit loops and one-hot ORs, the same idiom as
+ops/selb.py's lax twin), so they run on the shard-local block inside
+shard_map with no collectives.
+
+Wire math (the point): a dense wave payload is WW*4 bytes/row; the
+packed payload is b * itemsize bytes/row — 24 -> 6 at the lean
+geometry (WW=6, b=6, uint8) and 48 -> 12 at the default (WW=12, b=6,
+uint16), per neighbor-block transfer.  scripts/shard_anchor.py tallies
+the resulting per-chip ICI bytes for both wire formats.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+WORD = 32
+
+
+def slot_dtype(ww: int):
+    """Narrowest unsigned dtype that can index ww*32 slots AND spare its
+    max value as the empty sentinel (hence <=, not <)."""
+    nbits = ww * WORD
+    if nbits < 255:
+        return jnp.uint8
+    if nbits < 65535:
+        return jnp.uint16
+    return jnp.uint32
+
+
+def packed_itemsize(ww: int) -> int:
+    """Bytes per packed slot entry — the anchor model's tally unit."""
+    return jnp.dtype(slot_dtype(ww)).itemsize
+
+
+def pack_slots(sel: jax.Array, b: int) -> jax.Array:
+    """u32[S, WW] with <= b set bits per row -> slot indices [S, b].
+
+    Extracts set bits in ascending slot order: per pass, the first
+    nonzero word (argmax over a !=0 mask) and its lowest set bit
+    (isolate with x & -x, index by popcount(low - 1)), then clears that
+    bit and repeats.  Rows with fewer than b bits pad with the dtype-max
+    sentinel.  Bits beyond the b-th are silently dropped — callers must
+    only pack first-B-selected blocks (the engine invariant)."""
+    _, ww = sel.shape
+    dt = slot_dtype(ww)
+    wids = jnp.arange(ww, dtype=jnp.int32)[None, :]
+    one = jnp.uint32(1)
+    m = sel
+    cols = []
+    for _ in range(b):
+        nz = m != 0
+        has = jnp.any(nz, axis=1)
+        w = jnp.argmax(nz, axis=1).astype(jnp.int32)
+        hit = w[:, None] == wids
+        word = jnp.max(jnp.where(hit, m, jnp.uint32(0)), axis=1)
+        low = word & (jnp.uint32(0) - word)
+        bit = jax.lax.population_count(
+            jax.lax.bitcast_convert_type(low - one, jnp.int32))
+        slot = w * WORD + jnp.where(has, bit, 0)
+        cols.append(jnp.where(has, slot, jnp.iinfo(dt).max).astype(dt))
+        m = m ^ jnp.where(hit, low[:, None], jnp.uint32(0))
+    return jnp.stack(cols, axis=1)
+
+
+def unpack_slots(idx: jax.Array, ww: int) -> jax.Array:
+    """Slot indices [S, b] -> u32[S, ww] window block (inverse of
+    pack_slots on first-B-bounded input).  One one-hot OR pass per
+    packed column; sentinel entries (>= ww*32) contribute nothing."""
+    s, b = idx.shape
+    ii = idx.astype(jnp.int32)
+    valid = ii < ww * WORD
+    col = jnp.where(valid, ii // WORD, ww)         # ww: off every word
+    bit = jnp.where(valid, ii & (WORD - 1), 0).astype(jnp.uint32)
+    wids = jnp.arange(ww, dtype=jnp.int32)[None, :]
+    zero = jnp.uint32(0)
+    out = jnp.zeros((s, ww), jnp.uint32)
+    for j in range(b):
+        val = jnp.where(valid[:, j], jnp.uint32(1) << bit[:, j], zero)
+        out = out | jnp.where(col[:, j:j + 1] == wids, val[:, None], zero)
+    return out

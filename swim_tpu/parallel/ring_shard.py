@@ -16,6 +16,14 @@ movement (SURVEY.md §5 "Distributed comm backend"):
     with static-permutation `ppermute`s selected by a D-way
     `lax.switch` on k, then stitched with one dynamic slice.  Per roll:
     2 neighbor-block transfers on ICI — no all-gather, no replication.
+  * **Wave payloads → SWIM's bounded piggyback (optional).**  With
+    `cfg.ring_ici_wire == "compact"` the per-wave sel-window rolls do
+    not ship the dense u32[S, WW] block at all: the first-B-selected
+    rows (<= B set bits each — the protocol's own piggyback bound)
+    pack once per period into B slot indices (ops/wavepack.py), one
+    boundary block is prefetched, and each wave then moves ONE packed
+    [S, B] narrow-int block — ~WW*32/B fewer ICI bytes per wave,
+    bitwise-equal after receiver-side unpack (see merge_waves).
   * **Global reductions → psum** of per-shard partials (all integer —
     bitwise-exact, no float reassociation concerns).
   * **Node-axis scatter/gather by global id → masked local ops.**  Each
@@ -67,6 +75,7 @@ except ImportError:                              # pragma: no cover
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.models import ring
+from swim_tpu.ops import wavepack
 from swim_tpu.parallel import mesh as pmesh
 from swim_tpu.sim.faults import FaultPlan
 
@@ -98,6 +107,10 @@ class ShardOps:
         self.d = n_shards
         self.s = self.n // n_shards
         self.lo = jax.lax.axis_index(AXIS).astype(jnp.int32) * self.s
+        self.wire = cfg.ring_ici_wire
+        g = ring.geometry(cfg)
+        self.ww = g.ww
+        self.b_pig = min(cfg.max_piggyback, g.ww * ring.WORD)
 
     # -- node identity ----------------------------------------------------
     def ids(self):
@@ -254,16 +267,48 @@ class ShardOps:
     def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
         """GlobalOps.merge_waves twin: same values for this shard's
         rows.  The fused Pallas kernel needs the whole node axis in one
-        address space; here every wave's roll is already the
-        two-ppermute neighbor exchange, so the merge stays per-wave —
-        the ICI traffic is identical either way (one sel-window payload
-        per wave), and `impl` is a single-program concern."""
+        address space; here every wave's roll is a ppermute neighbor
+        exchange, so the merge stays per-wave, and `impl` is a
+        single-program concern.  What DOES change per cfg is the wire
+        format of the exchange (cfg.ring_ici_wire):
+
+          * "window": each wave roll_from's the dense sel window —
+            two u32[S, WW] neighbor blocks per wave on ICI.
+          * "compact": sel is first-B-selected (<= b_pig set bits per
+            row — SWIM's bounded piggyback), so it is packed ONCE into
+            slot indices idx[S, B] (ops/wavepack.py) and each wave
+            ships one packed block.  A global roll by d = k*S + r
+            factors as z = roll(idx, r) then take shard me+k of z; z is
+            REPLICATED-buildable locally from idx plus ONE boundary
+            fetch of the next shard's packed block (shared by all
+            waves, r < S), so each wave costs ONE switch-selected
+            ppermute of [S, B] narrow ints instead of two [S, WW] u32
+            blocks — ~WW*32/B fewer wave bytes, bitwise-equal after
+            receiver-side unpack (the values are single bits; only the
+            slot indices need to travel).
+
+        The same replicated-shift invariant as roll_from applies: wave
+        offsets derive from rnd.* fields, replicated by place()."""
         del impl
         zero = jnp.zeros((), jnp.uint32)
         out = win
-        for ok, d in zip(oks, offs):
-            out = out | jnp.where(ok[:, None], self.roll_from(sel, d),
-                                  zero)
+        if self.wire == "compact":
+            idx = wavepack.pack_slots(sel, self.b_pig)
+            both = jnp.concatenate([idx, self._rot(idx, 1)], axis=0)
+            for ok, d in zip(oks, offs):
+                dd = jnp.mod(jnp.asarray(d, jnp.int32), self.n)
+                k = dd // self.s
+                r = jnp.mod(dd, self.s)
+                z = jax.lax.dynamic_slice_in_dim(both, r, self.s, axis=0)
+                y = jax.lax.switch(
+                    k, [functools.partial(self._rot, k_static=kk)
+                        for kk in range(self.d)], z)
+                rolled = wavepack.unpack_slots(y, self.ww)
+                out = out | jnp.where(ok[:, None], rolled, zero)
+        else:
+            for ok, d in zip(oks, offs):
+                out = out | jnp.where(ok[:, None], self.roll_from(sel, d),
+                                      zero)
         wids = jnp.arange(win.shape[1], dtype=jnp.int32)[None, :]
         for col, val in zip(bcols, bvals):
             out = out | jnp.where(col[:, None] == wids, val[:, None],

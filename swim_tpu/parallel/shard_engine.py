@@ -36,6 +36,14 @@ Design (device d owns node rows [d·n/D, (d+1)·n/D)):
 Loss draws for response waves ride INSIDE the request tuples (an ack's
 Bernoulli draw is indexed by the original pinger, whose randomness lives
 on the pinger's shard), so no cross-shard randomness lookups exist.
+
+Design lineage note: this engine's founding move — put SWIM's O(N·k·B)
+bounded MESSAGES on the wire, never a dense O(N·R)/O(N·WW) state matrix
+— is the same confrontation the ring twin later adopted as
+`cfg.ring_ici_wire="compact"` (parallel/ring_shard.py merge_waves +
+ops/wavepack.py): there the bounded piggyback packs into B slot indices
+per row and each wave ships one packed block over ICI instead of the
+dense sel window.  One principle, two engines.
 """
 
 from __future__ import annotations

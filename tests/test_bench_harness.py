@@ -183,6 +183,104 @@ class TestLastGoodTpuGate:
         assert bench.load_last_good_tpu() is None
 
 
+class TestHeadlinePromotion:
+    """The CPU-fallback line's headline_tpu_* keys must come from ONE
+    pinned metric family, never a max() across unrelated metric strings
+    (ADVICE r5: a smaller-N capture with flashier periods/sec outranked
+    the flagship 1M record)."""
+
+    def _lg(self, bests, best=None):
+        lg = {"value": 1.0, "metric": "latest", "bests": bests}
+        if best is not None:
+            lg["best"] = best
+        return lg
+
+    def test_flagship_beats_bigger_small_n_value(self):
+        m1 = "simulated protocol-periods/sec @ 1000000 nodes (ringp " \
+             "engine, rotor probe, period-sel, default)"
+        m2 = "simulated protocol-periods/sec @ 65536 nodes (ringp " \
+             "engine, rotor probe, period-sel, default)"
+        top = bench.promote_headline(self._lg({
+            m1: {"value": 96.9, "metric": m1},
+            m2: {"value": 512.0, "metric": m2},    # small-N, flashier
+        }))
+        assert top["value"] == 96.9, top
+
+    def test_max_within_flagship_scale_only(self):
+        m1 = "simulated protocol-periods/sec @ 1000000 nodes (ringp " \
+             "engine, rotor probe, period-sel, default)"
+        m2 = "simulated protocol-periods/sec @ 4000000 nodes (ringp " \
+             "engine, rotor probe, period-sel, default)"
+        top = bench.promote_headline(self._lg({
+            m1: {"value": 96.9, "metric": m1},
+            m2: {"value": 120.0, "metric": m2},    # also flagship-scale
+        }))
+        assert top["value"] == 120.0
+
+    def test_falls_back_to_single_metric_best(self):
+        m2 = "simulated protocol-periods/sec @ 65536 nodes (ring " \
+             "engine, rotor probe, cpu)"
+        best = {"value": 9.0, "metric": m2}
+        top = bench.promote_headline(
+            self._lg({m2: {"value": 12.0, "metric": m2}}, best=best))
+        # no flagship-scale record: promote the latest capture's OWN
+        # defended best, not a cross-metric max
+        assert top is best
+
+    def test_garbage_shapes_yield_none(self):
+        assert bench.promote_headline(None) is None
+        assert bench.promote_headline({}) is None
+        assert bench.promote_headline(
+            self._lg({"m": {"value": "nan?"}}, best="oops")) is None
+
+
+class TestShardAnchorSmoke:
+    """The anchor model can never again land unexecuted (VERDICT r5):
+    --cpu-smoke traces the full-size per-chip ICI byte tallies for BOTH
+    wire formats in seconds, and the compact wire must hold its >= 8x
+    roll_sel_waves cut at the lean 1M/8-chip arm — the acceptance
+    number of the compact-wire PR."""
+
+    @pytest.fixture(scope="class")
+    def smoke(self):
+        import json
+        import subprocess
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, os.path.join(root, "scripts",
+                                          "shard_anchor.py"),
+             "--cpu-smoke"],
+            env=env, cwd=root, timeout=300, capture_output=True,
+            text=True)
+        assert r.returncode == 0, r.stderr[-2000:]
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    def test_both_wire_tallies_present_per_arm(self, smoke):
+        for name, arm in smoke["arms"].items():
+            for wire in ("window", "compact"):
+                bd = arm["wires"][wire]["ici_traced"]["breakdown"]
+                assert bd.get("roll_sel_waves", 0) > 0, (name, wire, bd)
+            assert "sel_wire_boundary" in \
+                arm["wires"]["compact"]["ici_traced"]["breakdown"]
+
+    def test_lean_compact_cut_meets_acceptance(self, smoke):
+        lean = smoke["arms"]["lean"]
+        assert lean["roll_sel_waves_reduction"] >= 8.0
+        w = lean["wires"]
+        assert (w["compact"]["ici_traced"]["ici_ceiling_pps"]
+                > 2 * w["window"]["ici_traced"]["ici_ceiling_pps"])
+
+    def test_smoke_is_trace_only(self, smoke):
+        """No chip measurement (that is the full run's job on real
+        hardware) and no artifact write from smoke mode."""
+        assert all(a["chip_measured"] is None
+                   for a in smoke["arms"].values())
+
+
 class TestWatcherCaptureChecks:
     def test_bench_payload_check(self):
         from scripts.tpu_watch import _bench_on_tpu

@@ -28,23 +28,31 @@ pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs the 8-device virtual mesh")
 
 
-def run_both(cfg, plan, periods, seed=7):
+def run_both(cfg, plan, periods, seed=7, shard_cfgs=()):
+    """Global engine at `cfg` vs the sharded twin at `cfg` AND at each
+    extra config in `shard_cfgs` (execution-layout variants of the same
+    protocol — e.g. ring_ici_wire="compact" — which must stay bitwise-
+    equal to the same single-program reference), period by period."""
     mesh = pmesh.make_mesh(8)
     key = jax.random.key(seed)
     g_state = ring.init_state(cfg)
-    s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
-                                       plan)
+    arms = []
+    for c in (cfg, *shard_cfgs):
+        st, pl = ring_shard.place(c, mesh, ring.init_state(c), plan)
+        arms.append({"label": c.ring_ici_wire, "state": st, "plan": pl,
+                     "step": ring_shard.build_step(c, mesh)})
     g_step = jax.jit(lambda s, r: ring.step(cfg, s, plan, r))
-    s_step = ring_shard.build_step(cfg, mesh)
     for t in range(periods):
         rnd = ring.draw_period_ring(key, t, cfg)
         g_state = g_step(g_state, rnd)
-        s_state = s_step(s_state, s_plan, rnd)
-        for name in g_state._fields:
-            a = np.asarray(getattr(g_state, name))
-            b = np.asarray(getattr(s_state, name))
-            np.testing.assert_array_equal(
-                a, b, err_msg=f"{name} @ period {t}")
+        for arm in arms:
+            arm["state"] = arm["step"](arm["state"], arm["plan"], rnd)
+            for name in g_state._fields:
+                a = np.asarray(getattr(g_state, name))
+                b = np.asarray(getattr(arm["state"], name))
+                np.testing.assert_array_equal(
+                    a, b,
+                    err_msg=f"{arm['label']}:{name} @ period {t}")
     return g_state
 
 
@@ -92,6 +100,36 @@ class TestBitwiseVsGlobal:
         plan = faults.with_loss(
             faults.with_crashes(faults.none(n), [5, 40], [2, 6]), 0.1)
         run_both(cfg, plan, 16, seed=9)
+
+    def test_period_sel_buddy_and_compact_wire(self):
+        """Two pins in one tri-run (ADVICE r5 + the compact-wire
+        tentpole): (a) lifeguard at period scope drives ShardOps.
+        merge_waves' bcols/bvals buddy OR path — previously untested
+        sharded — and (b) ring_ici_wire='compact' (packed slot-index
+        wave payloads, ops/wavepack.py) must match BOTH the dense-wire
+        shard and the single-program engine bitwise, with buddy forced
+        bits live."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         lifeguard=True, **SMALL_GEOM)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 40], [2, 6]), 0.1)
+        run_both(cfg, plan, 16, seed=9,
+                 shard_cfgs=(cfg.replace(ring_ici_wire="compact"),))
+
+    def test_compact_wire_partition_and_join(self):
+        """Compact wire under partition + late join (vanilla protocol):
+        the slot-index wire stays bitwise against the global engine when
+        the heard-set churns hard.  (Direct compact-vs-dense-wire parity
+        at identical cfg is pinned by the tri-run test above; running
+        the compact arm alone here saves one sharded compile.)"""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         ring_ici_wire="compact", **SMALL_GEOM)
+        plan = faults.with_partition(faults.none(n), [1] * 16 + [0] * 48,
+                                     3, 9)
+        plan = plan._replace(join_step=plan.join_step.at[21].set(4))
+        run_both(cfg, plan, 12, seed=17)
 
     def test_pull_mode(self):
         """Sharded pull-uniform probing (round 4; VERDICT r3 item 7's
@@ -177,3 +215,32 @@ class TestCommunicationPattern:
             if worst > 2048:        # OB*D = 512 keys is the honest max
                 big.append((worst, line.strip()[:120]))
         assert not big, f"replication-scale all-gathers: {big}"
+
+    def test_compact_wire_moves_packed_payloads(self):
+        """With ring_ici_wire='compact' the wave exchanges must ship
+        the packed slot-index payload (narrow ints), not the dense u32
+        window: the HLO's collective-permutes include u8-element
+        transfers (SMALL_GEOM's ww*32 = 128 slots fits uint8) and the
+        no-big-all-gather guarantee still holds."""
+        n = 4096
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         ring_ici_wire="compact", **SMALL_GEOM)
+        mesh = pmesh.make_mesh(8)
+        plan = faults.with_crashes(faults.none(n), [5], [2])
+        s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
+                                           plan)
+        rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
+        step = ring_shard.build_step(cfg, mesh)
+        txt = step.lower(s_state, s_plan, rnd).compile().as_text()
+
+        cperms = [l for l in txt.splitlines() if "collective-permute" in l
+                  and "=" in l]
+        assert cperms, "wave rolls must use ppermute"
+        assert any("u8[" in l for l in cperms), \
+            "no packed (u8) collective-permute payload found"
+        for line in txt.splitlines():
+            if "all-gather" not in line or "=" not in line:
+                continue
+            counts = [int(np.prod([int(d) for d in m.group(1).split(",")]))
+                      for m in re.finditer(r"\w+\[([\d,]+)\]", line)]
+            assert max(counts, default=1) <= 2048, line[:120]

@@ -1,0 +1,76 @@
+"""Unit pins for the compact ICI wire codec (swim_tpu/ops/wavepack.py).
+
+The sharded compact wave exchange (ring_ici_wire='compact') is exactly
+as correct as pack_slots/unpack_slots are inverse on bounded-piggyback
+input, so the codec gets direct pins: roundtrip against a numpy oracle
+over random <=B-bit rows, slot ordering, sentinel handling, and the
+dtype/itemsize choice the anchor model's byte tallies rely on.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swim_tpu.ops import wavepack
+
+
+def _random_bounded(rng, s, ww, b):
+    """[s, ww] u32 with 0..b set bits per row, uniformly placed."""
+    sel = np.zeros((s, ww), np.uint32)
+    for i in range(s):
+        for slot in rng.choice(ww * 32, size=rng.integers(0, b + 1),
+                               replace=False):
+            sel[i, slot // 32] |= np.uint32(1) << np.uint32(slot % 32)
+    return sel
+
+
+class TestRoundtrip:
+    @pytest.mark.parametrize("ww,b", [(4, 2), (6, 6), (12, 6), (2, 1)])
+    def test_unpack_inverts_pack(self, ww, b):
+        rng = np.random.default_rng(ww * 100 + b)
+        sel = _random_bounded(rng, 513, ww, b)
+        idx = wavepack.pack_slots(jnp.asarray(sel), b)
+        out = np.asarray(wavepack.unpack_slots(idx, ww))
+        np.testing.assert_array_equal(out, sel)
+
+    def test_full_rows_and_empty_rows(self):
+        ww, b = 3, 4
+        sel = np.zeros((4, ww), np.uint32)
+        sel[0] = 0                                   # empty row
+        sel[1, 0] = (1 << 4) - 1                     # b consecutive bits
+        sel[2, ww - 1] = np.uint32(1) << np.uint32(31)  # last slot alone
+        sel[3, 0] = 1                                # first slot alone
+        idx = wavepack.pack_slots(jnp.asarray(sel), b)
+        np.testing.assert_array_equal(
+            np.asarray(wavepack.unpack_slots(idx, ww)), sel)
+
+    def test_slots_ascend_then_sentinel(self):
+        """Entries come out in ascending slot order, padded with the
+        dtype-max sentinel — the layout the wire format documents."""
+        ww, b = 4, 3
+        rng = np.random.default_rng(42)
+        sel = _random_bounded(rng, 257, ww, b)
+        idx = np.asarray(wavepack.pack_slots(jnp.asarray(sel), b))
+        sent = np.iinfo(idx.dtype).max
+        for row in idx.astype(np.int64):
+            live = row[row < ww * 32]
+            assert np.all(np.diff(live) > 0)
+            assert np.all(row[len(live):] == sent)
+
+    def test_sentinel_never_collides_with_a_slot(self):
+        for ww in (1, 2, 4, 6, 7, 12, 64):
+            dt = wavepack.slot_dtype(ww)
+            assert ww * 32 - 1 < np.iinfo(dt).max
+
+
+class TestDtypeChoice:
+    def test_narrowest_dtype(self):
+        assert wavepack.slot_dtype(6) == jnp.uint8      # lean: 192 slots
+        assert wavepack.slot_dtype(7) == jnp.uint8      # 224 < 255
+        assert wavepack.slot_dtype(8) == jnp.uint16     # 256: u8 max taken
+        assert wavepack.slot_dtype(12) == jnp.uint16    # default: 384
+
+    def test_itemsize_matches_anchor_tally_unit(self):
+        assert wavepack.packed_itemsize(6) == 1
+        assert wavepack.packed_itemsize(12) == 2

@@ -1,0 +1,77 @@
+"""Lint: every `self.stats[...]` key in core/node.py must be declared.
+
+The typed registry (swim_tpu/obs/registry.py NODE_COUNTERS) superseded
+the flat stats dict; `MetricsRegistry.stats_view()` keeps the old
+`self.stats["probes"] += 1` call sites working but raises KeyError on an
+undeclared key — at runtime, on whichever code path first touches it.
+This script moves that failure to build time: it AST-walks core/node.py,
+collects every string literal used to subscript `self.stats`, and exits
+non-zero if any is missing from NODE_COUNTERS (or if a subscript key is
+not a plain string literal, which the view cannot type).
+
+Run directly (`python scripts/check_metrics_registry.py`) or via the
+fast tier-1 test that shells out to it (tests/test_telemetry.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NODE_PY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "swim_tpu", "core", "node.py")
+
+
+def stats_keys(path: str = NODE_PY) -> tuple[set[str], list[str]]:
+    """(string keys subscripting self.stats, non-literal subscript reprs)."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    keys: set[str] = set()
+    dynamic: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        v = node.value
+        if not (isinstance(v, ast.Attribute) and v.attr == "stats"
+                and isinstance(v.value, ast.Name) and v.value.id == "self"):
+            continue
+        s = node.slice
+        if isinstance(s, ast.Constant) and isinstance(s.value, str):
+            keys.add(s.value)
+        else:
+            dynamic.append(f"line {node.lineno}: {ast.unparse(s)}")
+    return keys, dynamic
+
+
+def main() -> int:
+    from swim_tpu.obs.registry import NODE_COUNTERS
+
+    keys, dynamic = stats_keys()
+    missing = sorted(keys - set(NODE_COUNTERS))
+    ok = True
+    if missing:
+        ok = False
+        print(f"UNDECLARED stats keys in core/node.py: {missing} — "
+              "declare them in swim_tpu.obs.registry.NODE_COUNTERS "
+              "(name -> help text)", file=sys.stderr)
+    if dynamic:
+        ok = False
+        print("non-literal self.stats subscripts (the typed view needs "
+              f"string-literal keys): {dynamic}", file=sys.stderr)
+    unused = sorted(set(NODE_COUNTERS) - keys)
+    if unused:
+        # declared-but-never-incremented is informational, not fatal:
+        # counters may be bumped outside node.py (tests, future callers)
+        print(f"note: declared counters not incremented in node.py: "
+              f"{unused}", file=sys.stderr)
+    print(f"checked {len(keys)} stats keys against "
+          f"{len(NODE_COUNTERS)} declared counters: "
+          f"{'OK' if ok else 'FAIL'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,14 +13,19 @@ mesh; this script only scrubs PALLAS_AXON_POOL_IPS so a dead axon TPU
 tunnel cannot hang interpreter startup (sitecustomize dials it when the
 var is set).
 
-Usage: python scripts/run_suite.py [--timeout-per-file S] [pattern]
-Exit 0 iff every file's pytest exited 0.
+Usage: python scripts/run_suite.py [--timeout-per-file S]
+         [--artifacts-dir DIR] [pattern]
+Exit 0 iff every file's pytest exited 0.  `--artifacts-dir DIR` copies
+the run's telemetry/bench artifacts (bench_results/*.json, any
+*flight_record*.jsonl the tests left behind) into DIR afterwards and
+prints the inventory — the collection a CI job would upload.
 """
 from __future__ import annotations
 
 import argparse
 import glob
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -29,10 +34,27 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def collect_artifacts(dest: str) -> list[str]:
+    """Copy bench/telemetry artifacts into `dest`; return rel paths."""
+    patterns = (os.path.join(REPO, "bench_results", "*.json"),
+                os.path.join(REPO, "*flight_record*.jsonl"),
+                os.path.join(REPO, "bench_results", "*.jsonl"))
+    os.makedirs(dest, exist_ok=True)
+    copied: list[str] = []
+    for pat in patterns:
+        for src in sorted(glob.glob(pat)):
+            shutil.copy2(src, os.path.join(dest, os.path.basename(src)))
+            copied.append(os.path.relpath(src, REPO))
+    return copied
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("pattern", nargs="?", default="tests/test_*.py")
     ap.add_argument("--timeout-per-file", type=float, default=2400.0)
+    ap.add_argument("--artifacts-dir", default=None,
+                    help="copy bench_results JSON + telemetry JSONL "
+                         "artifacts here after the run")
     args = ap.parse_args()
 
     files = sorted(glob.glob(os.path.join(REPO, args.pattern)))
@@ -98,6 +120,11 @@ def main() -> int:
     print(f"\n{len(files) - len(failures)}/{len(files)} files green "
           f"in {time.time() - t_all:.0f}s"
           + (f"; FAILED: {', '.join(failures)}" if failures else ""))
+    if args.artifacts_dir:
+        copied = collect_artifacts(args.artifacts_dir)
+        print(f"artifacts -> {args.artifacts_dir} ({len(copied)}):")
+        for rel in copied:
+            print(f"  {rel}")
     return 1 if failures else 0
 
 

@@ -117,85 +117,13 @@ def matched_cfg(kw: dict):
 
 def trace_ici_bytes(full_cfg) -> dict:
     """Per-chip ICI bytes/period the ShardOps layout would move at
-    N_FULL over D chips — tallied by shimming the ops seam during one
-    abstract (eval_shape) trace of the real step body.  The wave-
-    exchange tally follows `full_cfg.ring_ici_wire` (ShardOps.
-    merge_waves): "window" receives 2 dense sel blocks per wave;
-    "compact" receives 1 packed [S, B] slot-index block per wave plus
-    one boundary block per period (`sel_wire_boundary`)."""
-    import jax
-    import jax.numpy as jnp
+    N_FULL over D chips.  The CountingOps tally now lives in the
+    runtime telemetry layer (swim_tpu/obs/ici.py — the flight recorder
+    embeds the same dict in its dump header); this wrapper pins the
+    anchor script's D and ICI_GBPS constants."""
+    from swim_tpu.obs.ici import trace_ici_bytes as _trace
 
-    from swim_tpu.models import ring
-    from swim_tpu.ops import wavepack
-    from swim_tpu.sim import faults
-
-    tally: dict[str, int] = {}
-
-    def add(key, nbytes):
-        tally[key] = tally.get(key, 0) + int(nbytes)
-
-    class CountingOps(ring.GlobalOps):
-        def __init__(self, cfg, d):
-            super().__init__(cfg)
-            self.cfg = cfg
-            self.d = d
-
-        def roll_from(self, x, dd):
-            add(f"roll[{'x'.join(map(str, x.shape))},{x.dtype}]",
-                2 * x.size * x.dtype.itemsize // self.d)
-            return super().roll_from(x, dd)
-
-        def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
-            if self.cfg.ring_ici_wire == "compact":
-                ww = sel.shape[1]
-                row = (min(self.cfg.max_piggyback, ww * wavepack.WORD)
-                       * wavepack.packed_itemsize(ww))
-                add("sel_wire_boundary", sel.shape[0] * row // self.d)
-                add("roll_sel_waves",
-                    len(oks) * sel.shape[0] * row // self.d)
-            else:
-                add("roll_sel_waves",
-                    len(oks) * 2 * sel.size * sel.dtype.itemsize
-                    // self.d)
-            return super().merge_waves(win, sel, oks, offs, bcols,
-                                       bvals, impl="lax")
-
-        def gsum(self, partial):
-            add("psum_scalar",
-                4 * getattr(partial, "size", 1))
-            return super().gsum(partial)
-
-        def gather(self, arr, idx):
-            add("gather_psum", 4 * max(getattr(idx, "size", 1), 1))
-            return super().gather(arr, idx)
-
-        def knows_words(self, win, cold, slot_pos, rows, slot):
-            add("knows_psum", 4 * max(getattr(slot, "size", 1), 1))
-            return super().knows_words(win, cold, slot_pos, rows, slot)
-
-        def first_true_nodes(self, valid, k):
-            kl = min(k, self.n // self.d)
-            add("candidates_all_gather", 4 * self.d * kl)
-            return super().first_true_nodes(valid, k)
-
-    ops_c = CountingOps(full_cfg, D)
-
-    def one_period():
-        st = ring.init_state(full_cfg)
-        plan = faults.none(full_cfg.n_nodes)
-        rnd = ring.draw_period_ring(jax.random.key(0), jnp.int32(0),
-                                    full_cfg)
-        return ring.step(full_cfg, st, plan, rnd, ops=ops_c)
-
-    jax.eval_shape(one_period)
-    total = sum(tally.values())
-    t_ici_ms = total / (ICI_GBPS * 1e9) * 1e3
-    return {"per_chip_bytes_per_period": total,
-            "t_ici_ms": t_ici_ms,
-            "ici_ceiling_pps": round(1e3 / t_ici_ms, 1),
-            "breakdown": dict(sorted(tally.items(),
-                                     key=lambda kv: -kv[1]))}
+    return _trace(full_cfg, D, ici_gbps=ICI_GBPS)
 
 
 def measure_chip(cfg) -> dict:

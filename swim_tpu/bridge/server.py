@@ -26,9 +26,43 @@ from swim_tpu.core.node import Node
 from swim_tpu.core.transport import Address, InProcessTransport, SimNetwork
 
 
+def _make_metrics_server(host: str, port: int, nodes: list[Node]):
+    """Stdlib HTTP server exposing GET /metrics (Prometheus text 0.0.4)."""
+    import http.server
+
+    from swim_tpu.obs.expo import render_prometheus
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):                                  # noqa: N802
+            if self.path.split("?")[0] != "/metrics":
+                self.send_error(404)
+                return
+            body = render_prometheus(
+                ({"node": str(n.id)}, n.registry) for n in nodes)
+            data = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):                         # quiet
+            pass
+
+    return http.server.ThreadingHTTPServer((host, port), Handler)
+
+
 class BridgeServer:
+    """`metrics_port` (optional) additionally serves Prometheus text
+    exposition (swim_tpu/obs/expo.py) over plain HTTP: GET /metrics
+    renders every in-process node's typed counter/histogram registry
+    with a `node` label.  0 binds an ephemeral port (tests); None (the
+    default) serves no metrics endpoint."""
+
     def __init__(self, cfg: SwimConfig, n_internal: int, seed: int = 0,
-                 loss: float = 0.0, host: str = "127.0.0.1", port: int = 0):
+                 loss: float = 0.0, host: str = "127.0.0.1", port: int = 0,
+                 metrics_port: int | None = None):
         self.cfg = cfg
         self.clock = SimClock()
         self.network = SimNetwork(self.clock, seed=seed, loss=loss)
@@ -43,6 +77,12 @@ class BridgeServer:
         self._sock.listen(4)
         self.address: Address = self._sock.getsockname()
         self._thread: threading.Thread | None = None
+        self._metrics_httpd = None
+        self.metrics_address: Address | None = None
+        if metrics_port is not None:
+            self._metrics_httpd = _make_metrics_server(
+                host, metrics_port, self.nodes)
+            self.metrics_address = self._metrics_httpd.server_address[:2]
         self._started = False
         self._closing = False
         self._lock = threading.Lock()   # serializes command handling:
@@ -61,6 +101,9 @@ class BridgeServer:
         self._started = True
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        if self._metrics_httpd is not None:
+            threading.Thread(target=self._metrics_httpd.serve_forever,
+                             daemon=True).start()
 
     def _serve(self) -> None:
         """Accept co-process clients until every connected client has hung
@@ -178,6 +221,10 @@ class BridgeServer:
     def close(self) -> None:
         """Stop accepting new clients; existing connections finish."""
         self._closing = True
+        if self._metrics_httpd is not None:
+            self._metrics_httpd.shutdown()
+            self._metrics_httpd.server_close()
+            self._metrics_httpd = None
 
     def join(self, timeout: float = 10.0) -> None:
         if self._thread is not None:

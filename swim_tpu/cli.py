@@ -220,6 +220,15 @@ def _cmd_study(args: argparse.Namespace) -> int:
                   "ringshard", file=sys.stderr)
             return 2
         kw["ring_probe"] = args.probe   # flows into SwimConfig
+    if args.telemetry:
+        kw["telemetry"] = True          # flows into SwimConfig
+    if args.flight_record:
+        if args.study != "detection":
+            print("error: --flight-record is a detection-study option",
+                  file=sys.stderr)
+            return 2
+        kw["telemetry"] = True
+        kw["flight_record"] = args.flight_record
     if args.study == "detection":
         kw["crash_fraction"] = args.crash_fraction
     elif args.study == "fp_sweep":
@@ -252,10 +261,14 @@ def _cmd_bridge(args: argparse.Namespace) -> int:
     cfg = SwimConfig(n_nodes=max(args.internal + 1, 2),
                      lifeguard=args.lifeguard)
     server = BridgeServer(cfg, n_internal=args.internal, seed=args.seed,
-                          loss=args.loss, host=args.host, port=args.port)
+                          loss=args.loss, host=args.host, port=args.port,
+                          metrics_port=args.metrics_port)
     server.start()
-    print(json.dumps({"listening": list(server.address),
-                      "internal_nodes": args.internal}))
+    out = {"listening": list(server.address),
+           "internal_nodes": args.internal}
+    if server.metrics_address is not None:
+        out["metrics"] = list(server.metrics_address)
+    print(json.dumps(out))
     server.join(timeout=args.timeout)
     return 0
 
@@ -336,6 +349,15 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--budget-arms", action="store_true",
                     help="lifeguard study: add ring_orig_words=8 twin "
                          "arms (budget-vs-LHA attribution)")
+    st.add_argument("--telemetry", action="store_true",
+                    help="collect per-period engine telemetry "
+                         "(swim_tpu/obs EngineFrame) inside the study "
+                         "scan; adds a 'telemetry' digest to the JSON. "
+                         "Protocol state is bitwise identical either way")
+    st.add_argument("--flight-record", default=None, metavar="PATH",
+                    help="detection study: always dump the flight "
+                         "recorder's JSONL to PATH (implies --telemetry; "
+                         "without this, a dump still fires on anomaly)")
     st.add_argument("--probe", choices=("rotor", "pull"), default=None,
                     help="ring probe pattern override. The detection "
                          "study defaults BOTH ring layouts (ring and "
@@ -357,6 +379,9 @@ def build_parser() -> argparse.ArgumentParser:
     br.add_argument("--loss", type=float, default=0.0)
     br.add_argument("--lifeguard", action="store_true")
     br.add_argument("--timeout", type=float, default=3600.0)
+    br.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus text exposition on GET "
+                         "/metrics at this port (0 = ephemeral)")
     br.set_defaults(fn=_cmd_bridge)
     return p
 

@@ -119,6 +119,17 @@ class SwimConfig:
     #                              "wave" scope (per-wave re-selection
     #                              reads the live window, so the waves
     #                              cannot be fused) and in pull mode.
+    # --- observability (swim_tpu/obs/) ---
+    telemetry: bool = False      # per-period engine telemetry (EngineFrame
+    #                              counters: piggyback-slot saturation vs
+    #                              the B budget, sel-window occupancy,
+    #                              wave-merge deliveries, probe failures)
+    #                              collected inside the scan. Off by
+    #                              default; the tap is additive — protocol
+    #                              state is bitwise identical either way
+    #                              (tests/test_ring_shard.py pins it) and
+    #                              the measured overhead contract lives in
+    #                              bench.py --telemetry-overhead.
     ring_ici_wire: str = "window"  # sharded wave-exchange payload
     #                              (parallel/ring_shard.py; inert in the
     #                              single-program engine, which has no

@@ -17,7 +17,9 @@ from swim_tpu.types import Status
 
 class SimCluster:
     def __init__(self, cfg: SwimConfig, seed: int = 0, loss: float = 0.0,
-                 latency: float = 0.001):
+                 latency: float = 0.001, trace=None):
+        # `trace`: optional swim_tpu.obs.trace.TraceSink shared by every
+        # node — probe/suspicion lifecycle spans from the whole cluster
         self.cfg = cfg
         self.clock = SimClock()
         self.network = SimNetwork(self.clock, seed=seed, loss=loss,
@@ -26,7 +28,8 @@ class SimCluster:
         roster = []
         for i in range(cfg.n_nodes):
             t = InProcessTransport(self.network, i)
-            self.nodes.append(Node(cfg, i, t, self.clock, seed=seed * 7919 + i))
+            self.nodes.append(Node(cfg, i, t, self.clock,
+                                   seed=seed * 7919 + i, trace=trace))
             roster.append((i, t.local_address))
         for node in self.nodes:
             node.bootstrap(roster)

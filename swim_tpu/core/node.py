@@ -27,34 +27,40 @@ from swim_tpu.core.codec import (Address, DecodeError, Message, WireUpdate,
                                  decode, encode)
 from swim_tpu.core.gossip import PiggybackQueue
 from swim_tpu.core.membership import MembershipTable
+from swim_tpu.obs.registry import MetricsRegistry
+from swim_tpu.obs.trace import Span, TraceSink
 from swim_tpu.types import MsgKind, Opinion, Status
 
 
 class _Probe:
-    __slots__ = ("target", "acked", "nacked", "timers")
+    __slots__ = ("target", "acked", "nacked", "timers", "started", "span")
 
     def __init__(self, target: int):
         self.target = target
         self.acked = False
         self.nacked = False
         self.timers: list[TimerHandle] = []
+        self.started = 0.0
+        self.span: Span | None = None
 
 
 class _Suspicion:
-    __slots__ = ("incarnation", "timer", "confirmers", "started")
+    __slots__ = ("incarnation", "timer", "confirmers", "started", "span")
 
     def __init__(self, incarnation: int, timer: TimerHandle, started: float):
         self.incarnation = incarnation
         self.timer = timer
         self.confirmers: set[int] = set()
         self.started = started
+        self.span: Span | None = None
 
 
 class Node:
     def __init__(self, cfg: SwimConfig, node_id: int, transport, clock: Clock,
                  seed: int | None = None,
                  on_event: Callable[[int, Opinion | None, Opinion], None]
-                 | None = None):
+                 | None = None,
+                 trace: TraceSink | None = None):
         self.cfg = cfg
         self.id = node_id
         self.transport = transport
@@ -72,10 +78,14 @@ class Node:
         self._seq = itertools.count(1)
         self._tick_timer: TimerHandle | None = None
         self._running = False
-        # stats (observability; see utils/metrics for aggregation)
-        self.stats = {"probes": 0, "probe_failures": 0, "suspicions": 0,
-                      "refutations": 0, "deaths_declared": 0,
-                      "messages_in": 0, "messages_out": 0, "decode_errors": 0}
+        # observability (swim_tpu/obs/): typed counter/histogram registry;
+        # `stats` is a dict-compatible view over its counters (aggregation
+        # in utils/metrics, exposition in obs/expo — undeclared keys raise,
+        # scripts/check_metrics_registry.py enforces the declaration).
+        # `trace` receives probe/suspicion lifecycle spans; None = off.
+        self.registry = MetricsRegistry.node_default()
+        self.stats = self.registry.stats_view()
+        self.trace = trace
 
     # ------------------------------------------------------------------ API
 
@@ -122,6 +132,10 @@ class Node:
         self.stats["probes"] += 1
         seq = next(self._seq)
         probe = _Probe(target)
+        probe.started = self.clock.now()
+        if self.trace is not None:
+            probe.span = Span("probe", self.id, target, probe.started)
+            probe.span.event(probe.started, "ping")
         self._probes[seq] = probe
         self._send(target, Message(kind=MsgKind.PING, sender=self.id,
                                    probe_seq=seq),
@@ -148,6 +162,8 @@ class Node:
             return
         for proxy in self.members.random_members(
                 self.cfg.k_indirect, {self.id, probe.target}):
+            if probe.span is not None:
+                probe.span.event(self.clock.now(), "ping-req")
             self._send(proxy, Message(kind=MsgKind.PING_REQ, sender=self.id,
                                       probe_seq=seq, target=probe.target,
                                       target_addr=target_addr))
@@ -162,6 +178,9 @@ class Node:
             # +1; failed round where nacks proved our network path works: 0.
             delta = -1 if ok else (0 if probe.nacked else 1)
             self.lha = min(max(self.lha + delta, 0), self.cfg.lha_max)
+        if probe.span is not None and self.trace is not None:
+            self.trace.emit(probe.span.finish(self.clock.now(),
+                                              "ack" if ok else "fail"))
         if ok:
             return
         self.stats["probe_failures"] += 1
@@ -181,14 +200,33 @@ class Node:
         old = self._suspicions.pop(member, None)
         if old is not None:
             old.timer.cancel()
+            self._finish_suspicion(member, old, "superseded")
         timeout = self._suspicion_timeout(0)
         timer = self.clock.call_later(
             timeout, lambda: self._on_suspicion_expired(member))
         s = _Suspicion(incarnation, timer, self.clock.now())
         if origin is not None:
             s.confirmers.add(origin)
+        if self.trace is not None:
+            s.span = Span("suspicion", self.id, member, s.started)
         self._suspicions[member] = s
         self.stats["suspicions"] += 1
+
+    def _finish_suspicion(self, member: int, s: _Suspicion,
+                          outcome: str) -> None:
+        """Record a suspicion's resolution (histogram + span)."""
+        self.registry.observe("suspicion_duration_seconds",
+                              self.clock.now() - s.started)
+        if s.span is not None and self.trace is not None:
+            self.trace.emit(s.span.finish(self.clock.now(), outcome))
+
+    def _cancel_suspicion(self, member: int) -> None:
+        """Drop a suspicion refuted/overridden by fresher gossip."""
+        s = self._suspicions.pop(member, None)
+        if s is None:
+            return
+        s.timer.cancel()
+        self._finish_suspicion(member, s, "refuted")
 
     def _suspicion_timeout(self, confirmations: int) -> float:
         n = max(self.members.alive_count(), 2)
@@ -213,6 +251,8 @@ class Node:
                 or from_node in s.confirmers:
             return
         s.confirmers.add(from_node)
+        if s.span is not None:
+            s.span.event(self.clock.now(), "confirm")
         if not (self.cfg.lifeguard and self.cfg.dynamic_suspicion):
             return
         elapsed = self.clock.now() - s.started
@@ -229,8 +269,10 @@ class Node:
             return
         op = self.members.opinion(member)
         if op is None or op.status != Status.SUSPECT:
+            self._finish_suspicion(member, s, "superseded")
             return
         self.stats["deaths_declared"] += 1
+        self._finish_suspicion(member, s, "confirmed")
         self._apply_and_gossip(member, Opinion(Status.DEAD, op.incarnation))
 
     # ------------------------------------------------------------- receive
@@ -300,6 +342,11 @@ class Node:
             return
         probe = self._probes.get(msg.probe_seq)
         if probe is not None:
+            if not probe.acked:
+                self.registry.observe("probe_rtt_seconds",
+                                      self.clock.now() - probe.started)
+                if probe.span is not None:
+                    probe.span.event(self.clock.now(), "ack")
             probe.acked = True
 
     def _on_nack(self, msg: Message, src: Address) -> None:
@@ -308,6 +355,8 @@ class Node:
         probe = self._probes.get(msg.probe_seq)
         if probe is not None:
             probe.nacked = True
+            if probe.span is not None:
+                probe.span.event(self.clock.now(), "nack")
 
     def _on_join(self, msg: Message, src: Address) -> None:
         self._note_and_gossip(msg.sender, src)
@@ -339,8 +388,8 @@ class Node:
             if u.status == Status.SUSPECT:
                 self._start_suspicion_timer(u.member, u.incarnation,
                                             origin=u.origin)
-            elif u.member in self._suspicions:
-                self._suspicions.pop(u.member).timer.cancel()
+            else:
+                self._cancel_suspicion(u.member)
 
     def _handle_self_update(self, u: WireUpdate) -> None:
         """Someone claims we are suspect/dead → refute if we can."""
@@ -369,8 +418,8 @@ class Node:
             if op.status == Status.SUSPECT:
                 self._start_suspicion_timer(member, op.incarnation,
                                             origin=self.id)
-            elif member in self._suspicions:
-                self._suspicions.pop(member).timer.cancel()
+            else:
+                self._cancel_suspicion(member)
 
     # ---------------------------------------------------------------- wire
 

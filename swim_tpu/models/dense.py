@@ -104,8 +104,15 @@ def _apply_forced(cfg: SwimConfig, sel_idx, sel_valid, forced):
 
 
 def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
-         rnd: PeriodRandomness) -> DenseState:
-    """One protocol period for all N nodes (pure; jit with cfg static)."""
+         rnd: PeriodRandomness, tap: dict | None = None) -> DenseState:
+    """One protocol period for all N nodes (pure; jit with cfg static).
+
+    `tap` (optional, static presence) receives per-period telemetry
+    scalars (swim_tpu/obs/engine.py EngineFrame keys).  The tap never
+    feeds back into state; with tap=None the traced program is
+    unchanged, so telemetry-on state is bitwise identical to
+    telemetry-off.
+    """
     n, k = cfg.n_nodes, cfg.k_indirect
     t = state.step
     key, retransmit, deadline, lha = (state.key, state.retransmit,
@@ -270,6 +277,25 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     retransmit = jnp.where(frozen, state.retransmit, retransmit)
     deadline = jnp.where(frozen, state.deadline, deadline)
     lha = jnp.where(~up, state.lha, lha)
+
+    if tap is not None:
+        # ---- telemetry tap (swim_tpu/obs/engine.py EngineFrame) ----------
+        # Selection stats measure the start-of-period piggyback pass;
+        # occupancy counts still-transmissible (sender, subject) entries.
+        b = min(cfg.max_piggyback, n)
+        _, val0 = _piggyback(cfg, state.retransmit)
+        row_bits = jnp.sum(val0.astype(jnp.int32), axis=-1)        # [N]
+        tap["sel_slots_selected"] = jnp.sum(row_bits)
+        tap["sel_rows_saturated"] = jnp.sum(
+            ((row_bits >= b) & up).astype(jnp.int32))
+        tap["sel_slots_max"] = jnp.max(row_bits)
+        tap["win_occupancy"] = jnp.sum(
+            (state.retransmit < cfg.retransmit_limit).astype(jnp.int32))
+        tap["waves_delivered"] = (
+            jnp.sum(w1_ok) + jnp.sum(w2_ok) + jnp.sum(w3_ok)
+            + jnp.sum(w4_ok) + jnp.sum(w5_ok)
+            + jnp.sum(w6_ok)).astype(jnp.int32)
+        tap["probes_failed"] = jnp.sum(failed).astype(jnp.int32)
 
     return DenseState(key, retransmit, deadline, lha, t + 1)
 

@@ -209,8 +209,15 @@ def view_matrix(cfg: SwimConfig, state: RumorState) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
-         rnd: RumorRandomness) -> RumorState:
-    """One protocol period for all N nodes (pure; jit with cfg static)."""
+         rnd: RumorRandomness, tap: dict | None = None) -> RumorState:
+    """One protocol period for all N nodes (pure; jit with cfg static).
+
+    `tap` (optional, static presence) receives per-period telemetry
+    scalars (swim_tpu/obs/engine.py EngineFrame keys).  The tap never
+    feeds back into state; with tap=None the traced program is
+    unchanged, so telemetry-on state is bitwise identical to
+    telemetry-off.
+    """
     n, k, r_cap = cfg.n_nodes, cfg.k_indirect, cfg.rumor_slots
     s_cap = cfg.sentinels
     t = state.step
@@ -569,6 +576,27 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     # meaningful for rumors that are still in the table.
     inc_self = jnp.where(~up, state.inc_self, inc_self)
     lha = jnp.where(~up, state.lha, lha)
+
+    if tap is not None:
+        # ---- telemetry tap (swim_tpu/obs/engine.py EngineFrame) ----------
+        # Selection stats measure the start-of-period piggyback pass (the
+        # window the first wave consults); occupancy counts (node,
+        # eligible-rumor) heard pairs at period start.
+        kn0 = state.knows[:, cand_idx] & cand_valid[None, :]
+        _, val0 = select_first_b(kn0)
+        row_bits = jnp.sum(val0.astype(jnp.int32), axis=-1)        # [N]
+        tap["sel_slots_selected"] = jnp.sum(row_bits)
+        tap["sel_rows_saturated"] = jnp.sum(
+            ((row_bits >= b_pig) & up).astype(jnp.int32))
+        tap["sel_slots_max"] = jnp.max(row_bits)
+        tap["win_occupancy"] = jnp.sum(
+            (state.knows & eligible[None, :]).astype(jnp.int32))
+        tap["waves_delivered"] = (
+            jnp.sum(w1_ok) + jnp.sum(w2_ok) + jnp.sum(w3_ok)
+            + jnp.sum(w4_ok) + jnp.sum(w5_ok)
+            + jnp.sum(w6_ok)).astype(jnp.int32)
+        tap["probes_failed"] = jnp.sum(failed).astype(jnp.int32)
+        tap["overflow"] = overflow
 
     return RumorState(
         knows=knows, inc_self=inc_self, lha=lha, gone_key=gone_key,

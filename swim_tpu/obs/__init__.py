@@ -1,0 +1,41 @@
+"""Unified telemetry layer (engines + real nodes).
+
+Two stacks, one subsystem:
+
+* **On-device engine telemetry** — `SwimConfig.telemetry` gates a
+  per-period `EngineFrame` of counters collected *inside* the engines'
+  scan (piggyback-slot saturation vs the B budget, sel-window
+  occupancy, wave-merge deliveries, probe failures, overflow).  The tap
+  is purely additive: protocol state with telemetry on is bitwise
+  identical to telemetry off (tests/test_ring_shard.py pins it across
+  the sharded tri-run), and the measured overhead contract lives in
+  `bench.py --telemetry-overhead`.  A bounded `FlightRecorder` keeps the
+  last K frames and dumps JSONL on anomaly or on demand; `trace_ici_bytes`
+  promotes scripts/shard_anchor.py's per-collective ICI tally into the
+  runtime.
+
+* **Real-node structured tracing** — `TraceSink` receives
+  probe-lifecycle `Span`s from core/node.py, `MetricsRegistry` is the
+  typed counter/histogram registry behind the nodes' `stats` mapping,
+  and `render_prometheus` is the text exposition served by the bridge
+  server's `/metrics` endpoint.
+
+See docs/OBSERVABILITY.md for knobs, schemas, and semantics.
+"""
+
+from swim_tpu.obs.engine import (EngineFrame, RecordedRun, empty_frame,
+                                 frame_from_tap, recorded_ring_run)
+from swim_tpu.obs.ici import trace_ici_bytes
+from swim_tpu.obs.recorder import FlightRecorder
+from swim_tpu.obs.registry import (NODE_COUNTERS, NODE_HISTOGRAMS, Counter,
+                                   Histogram, MetricsRegistry)
+from swim_tpu.obs.trace import JsonlSink, ListSink, NullSink, Span, TraceSink
+from swim_tpu.obs.expo import render_prometheus
+
+__all__ = [
+    "EngineFrame", "RecordedRun", "empty_frame", "frame_from_tap",
+    "recorded_ring_run", "trace_ici_bytes", "FlightRecorder",
+    "NODE_COUNTERS", "NODE_HISTOGRAMS", "Counter", "Histogram",
+    "MetricsRegistry", "Span", "TraceSink", "NullSink", "ListSink",
+    "JsonlSink", "render_prometheus",
+]

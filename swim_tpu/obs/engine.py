@@ -1,0 +1,99 @@
+"""On-device engine telemetry: the per-period EngineFrame tap.
+
+The engines' `step` functions accept an optional `tap` dict.  When the
+caller passes one (`cfg.telemetry` decides where the engines are driven
+by a runner), the step writes replicated i32 scalars into it — computed
+through the same `ops` seam as the protocol (`ops.gsum` / `ops.gmax`),
+so the sharded twin produces the SAME frame values as the single-program
+engine.  When `tap` is None (the default) the traced program is
+unchanged, which is what makes the telemetry-on/off bitwise-parity pin
+structural rather than lucky.
+
+Frame fields (all i32, per period):
+
+  sel_slots_selected  valid piggyback slots selected across all senders
+                      this period (the B-budget spend)
+  sel_rows_saturated  senders whose selection used the FULL B budget —
+                      saturation here means the compact wire's bounded
+                      [S, B] payload is the binding constraint
+  sel_slots_max       max per-sender valid-slot count (headroom vs B,
+                      and vs the u8/u16 slot-index packing of the
+                      compact wire: indices stay < ww*32 by geometry)
+  win_occupancy       transmissible candidates at selection time (ring:
+                      set bits in the eligible sel window; rumor:
+                      eligible rumors; dense: pending retransmit
+                      entries)
+  waves_delivered     messages delivered across every wave this period
+  probes_failed       probes with neither direct nor relayed ack
+  overflow            cumulative origination overflow (post-step state)
+  index_overflow      cumulative view-index overflow (ring engines)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class EngineFrame(NamedTuple):
+    """One period's telemetry counters (i32 scalars; i32[T] when stacked)."""
+
+    sel_slots_selected: jax.Array
+    sel_rows_saturated: jax.Array
+    sel_slots_max: jax.Array
+    win_occupancy: jax.Array
+    waves_delivered: jax.Array
+    probes_failed: jax.Array
+    overflow: jax.Array
+    index_overflow: jax.Array
+
+
+def empty_frame() -> EngineFrame:
+    return EngineFrame(*(jnp.int32(0) for _ in EngineFrame._fields))
+
+
+def frame_from_tap(tap: dict) -> EngineFrame:
+    """Build a frame from whatever keys the engine filled; rest are 0."""
+    return EngineFrame(*(jnp.asarray(tap.get(name, 0), jnp.int32)
+                         for name in EngineFrame._fields))
+
+
+class RecordedRun(NamedTuple):
+    """A telemetry run's result: final state + stacked EngineFrame[T].
+
+    `.step` proxies the state's period counter so bench.py's `_time_run`
+    execution-proof (end_step - start_step == periods) applies unchanged
+    to the telemetry arm.
+    """
+
+    state: Any
+    frames: EngineFrame
+
+    @property
+    def step(self):
+        return self.state.step
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def recorded_ring_run(cfg, state, plan, root_key: jax.Array,
+                      periods: int) -> RecordedRun:
+    """ring.run with the telemetry tap: one fused scan, frames as ys.
+
+    The frames are scan OUTPUTS — materialized whether or not the caller
+    reads them, so the bench overhead arm measures the real collector
+    cost instead of a dead-code-eliminated no-op.
+    """
+    from swim_tpu.models import ring
+
+    def body(st, _):
+        tap: dict = {}
+        st = ring.step(cfg, st, plan,
+                       ring.draw_period_ring(root_key, st.step, cfg),
+                       tap=tap)
+        return st, frame_from_tap(tap)
+
+    state, frames = jax.lax.scan(body, state, None, length=periods)
+    return RecordedRun(state, frames)

@@ -1,0 +1,83 @@
+"""Prometheus text exposition (format 0.0.4) for node registries.
+
+`render_prometheus` takes `(labels, registry)` pairs — the bridge server
+passes one pair per in-process node with `{"node": "<id>"}` — and
+renders every declared counter and histogram with HELP/TYPE metadata.
+Counters follow the `_total` suffix convention; histograms emit
+cumulative `_bucket{le=...}` series plus `_sum`/`_count`.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from swim_tpu.obs.registry import MetricsRegistry
+
+NAMESPACE = "swim"
+
+
+def _fmt_labels(labels: dict[str, str], extra: dict[str, str]
+                | None = None) -> str:
+    merged = {**labels, **(extra or {})}
+    if not merged:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in merged.items())
+    return "{" + inner + "}"
+
+
+def _fmt_float(v: float) -> str:
+    return repr(float(v)) if v != int(v) else str(int(v))
+
+
+def render_prometheus(registries: Iterable[tuple[dict[str, str],
+                                                 MetricsRegistry]],
+                      namespace: str = NAMESPACE) -> str:
+    pairs = list(registries)
+    lines: list[str] = []
+
+    counter_names: list[str] = []
+    hist_names: list[str] = []
+    for _, reg in pairs:
+        for name in reg.counters:
+            if name not in counter_names:
+                counter_names.append(name)
+        for name in reg.histograms:
+            if name not in hist_names:
+                hist_names.append(name)
+
+    for name in counter_names:
+        full = f"{namespace}_{name}_total"
+        helped = False
+        for labels, reg in pairs:
+            c = reg.counters.get(name)
+            if c is None:
+                continue
+            if not helped:
+                lines.append(f"# HELP {full} {c.help}")
+                lines.append(f"# TYPE {full} counter")
+                helped = True
+            lines.append(f"{full}{_fmt_labels(labels)} {c.value}")
+
+    for name in hist_names:
+        full = f"{namespace}_{name}"
+        helped = False
+        for labels, reg in pairs:
+            h = reg.histograms.get(name)
+            if h is None:
+                continue
+            if not helped:
+                lines.append(f"# HELP {full} {h.help}")
+                lines.append(f"# TYPE {full} histogram")
+                helped = True
+            cum = h.cumulative()
+            for ub, count in zip(h.buckets, cum):
+                lines.append(f"{full}_bucket"
+                             f"{_fmt_labels(labels, {'le': _fmt_float(ub)})}"
+                             f" {count}")
+            lines.append(f"{full}_bucket"
+                         f"{_fmt_labels(labels, {'le': '+Inf'})} {cum[-1]}")
+            lines.append(f"{full}_sum{_fmt_labels(labels)} "
+                         f"{_fmt_float(h.sum)}")
+            lines.append(f"{full}_count{_fmt_labels(labels)} {h.count}")
+
+    return "\n".join(lines) + "\n"

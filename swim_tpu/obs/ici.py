@@ -1,0 +1,102 @@
+"""Per-collective ICI byte accounting for the sharded ring engine.
+
+Promoted from scripts/shard_anchor.py (which now imports this) into the
+runtime telemetry layer: `trace_ici_bytes(cfg, d)` tallies, during one
+abstract (`jax.eval_shape`) trace of the real `ring.step` body, exactly
+the bytes the ShardOps layout would move per chip per period for
+`cfg.ring_ici_wire` — the dense "window" wire (2 u32[S, WW] neighbor
+blocks per wave roll) or the "compact" wire (the first-B piggyback
+packed as slot indices, ops/wavepack.py: one [S, B] narrow-int block per
+wave plus one shared boundary fetch per period) — plus psum payloads for
+reductions/replicated gathers and the [D, kl] candidate all_gather.
+
+The tally is static per (cfg, d): the wave schedule, payload shapes and
+collective set are compile-time constants, so the per-period byte cost
+does not vary at runtime.  The flight recorder embeds it in the dump
+header so every telemetry artifact is self-describing about its wire.
+
+Time model (kept from the anchor script, documented there in full): the
+per-chip RECEIVED bytes divided by ONE link's per-direction bandwidth —
+a deliberate serial-link lower bound on the ICI ceiling.
+"""
+
+from __future__ import annotations
+
+V5E_ICI_GBPS = 45.0   # v5e ICI, per link per direction (public figure)
+
+
+def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS) -> dict:
+    """Per-chip ICI bytes/period the ShardOps layout moves for `cfg`
+    sharded over `d` devices, keyed by collective (trace-derived)."""
+    import jax
+    import jax.numpy as jnp
+
+    from swim_tpu.models import ring
+    from swim_tpu.ops import wavepack
+    from swim_tpu.sim import faults
+
+    tally: dict[str, int] = {}
+
+    def add(key, nbytes):
+        tally[key] = tally.get(key, 0) + int(nbytes)
+
+    class CountingOps(ring.GlobalOps):
+        def __init__(self, cfg, d):
+            super().__init__(cfg)
+            self.cfg = cfg
+            self.d = d
+
+        def roll_from(self, x, dd):
+            add(f"roll[{'x'.join(map(str, x.shape))},{x.dtype}]",
+                2 * x.size * x.dtype.itemsize // self.d)
+            return super().roll_from(x, dd)
+
+        def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
+            if self.cfg.ring_ici_wire == "compact":
+                ww = sel.shape[1]
+                row = (min(self.cfg.max_piggyback, ww * wavepack.WORD)
+                       * wavepack.packed_itemsize(ww))
+                add("sel_wire_boundary", sel.shape[0] * row // self.d)
+                add("roll_sel_waves",
+                    len(oks) * sel.shape[0] * row // self.d)
+            else:
+                add("roll_sel_waves",
+                    len(oks) * 2 * sel.size * sel.dtype.itemsize
+                    // self.d)
+            return super().merge_waves(win, sel, oks, offs, bcols,
+                                       bvals, impl="lax")
+
+        def gsum(self, partial):
+            add("psum_scalar",
+                4 * getattr(partial, "size", 1))
+            return super().gsum(partial)
+
+        def gather(self, arr, idx):
+            add("gather_psum", 4 * max(getattr(idx, "size", 1), 1))
+            return super().gather(arr, idx)
+
+        def knows_words(self, win, cold, slot_pos, rows, slot):
+            add("knows_psum", 4 * max(getattr(slot, "size", 1), 1))
+            return super().knows_words(win, cold, slot_pos, rows, slot)
+
+        def first_true_nodes(self, valid, k):
+            kl = min(k, self.n // self.d)
+            add("candidates_all_gather", 4 * self.d * kl)
+            return super().first_true_nodes(valid, k)
+
+    ops_c = CountingOps(cfg, d)
+
+    def one_period():
+        st = ring.init_state(cfg)
+        plan = faults.none(cfg.n_nodes)
+        rnd = ring.draw_period_ring(jax.random.key(0), jnp.int32(0), cfg)
+        return ring.step(cfg, st, plan, rnd, ops=ops_c)
+
+    jax.eval_shape(one_period)
+    total = sum(tally.values())
+    t_ici_ms = total / (ici_gbps * 1e9) * 1e3
+    return {"per_chip_bytes_per_period": total,
+            "t_ici_ms": t_ici_ms,
+            "ici_ceiling_pps": round(1e3 / t_ici_ms, 1),
+            "breakdown": dict(sorted(tally.items(),
+                                     key=lambda kv: -kv[1]))}

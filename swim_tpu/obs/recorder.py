@@ -1,0 +1,102 @@
+"""Bounded flight recorder for engine telemetry frames.
+
+Keeps the last K periods of `EngineFrame` counters in a host-side ring
+buffer and serializes them as JSONL on anomaly or on demand.  The dump
+is self-describing: line 1 is a header object (schema version, dump
+reason, frame field names, config snapshot, optional per-collective ICI
+byte tally from obs/ici.py), every following line is one period's frame.
+
+`FlightRecorder.load` round-trips a dump back into a NamedTuple of
+arrays shaped like the engines' stacked frames, so
+`swim_tpu.utils.metrics.series_digest` works on re-read artifacts
+exactly as it does on live ones (tests/test_telemetry.py pins the
+round trip).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+from collections import namedtuple
+from typing import Any
+
+import numpy as np
+
+from swim_tpu.obs.engine import EngineFrame
+
+KIND = "swim_tpu_flight_recorder"
+VERSION = 1
+
+
+class FlightRecorder:
+    """Host-side ring buffer of the last `capacity` telemetry frames."""
+
+    def __init__(self, cfg: Any = None, capacity: int = 64,
+                 ici_bytes: dict | None = None):
+        if capacity < 1:
+            raise ValueError("flight recorder needs capacity >= 1")
+        self.capacity = capacity
+        self.cfg = cfg
+        self.ici_bytes = ici_bytes
+        self._frames: collections.deque[dict] = collections.deque(
+            maxlen=capacity)
+
+    def __len__(self) -> int:
+        return len(self._frames)
+
+    def record(self, period: int, frame: Any) -> None:
+        """Append one period.  `frame` is an EngineFrame of scalars or any
+        mapping/NamedTuple with (a subset of) its fields."""
+        if hasattr(frame, "_asdict"):
+            frame = frame._asdict()
+        row = {"period": int(period)}
+        for name in EngineFrame._fields:
+            row[name] = int(frame.get(name, 0))
+        self._frames.append(row)
+
+    def record_stacked(self, frames: Any, start_period: int = 0) -> None:
+        """Feed a stacked EngineFrame (arrays of shape [T]) period by
+        period — the shape the engines' scans emit."""
+        cols = {name: np.asarray(getattr(frames, name))
+                for name in EngineFrame._fields}
+        t_len = len(next(iter(cols.values())))
+        for t in range(t_len):
+            self.record(start_period + t,
+                        {name: cols[name][t] for name in cols})
+
+    def dump(self, path: str, reason: str = "on_demand") -> str:
+        """Write the buffer as JSONL (header line + one line/period)."""
+        header = {
+            "kind": KIND,
+            "version": VERSION,
+            "reason": reason,
+            "fields": list(EngineFrame._fields),
+            "capacity": self.capacity,
+            "periods": len(self._frames),
+        }
+        if self.cfg is not None:
+            header["cfg"] = dataclasses.asdict(self.cfg)
+        if self.ici_bytes is not None:
+            header["ici_bytes"] = self.ici_bytes
+        with open(path, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for row in self._frames:
+                f.write(json.dumps(row) + "\n")
+        return path
+
+    @staticmethod
+    def load(path: str) -> tuple[dict, Any]:
+        """Re-read a dump: (header, frames) where `frames` is a NamedTuple
+        of i64 arrays ([T] per field, plus `period`) digestible by
+        `metrics.series_digest`."""
+        with open(path) as f:
+            lines = [json.loads(line) for line in f if line.strip()]
+        if not lines or lines[0].get("kind") != KIND:
+            raise ValueError(f"{path} is not a {KIND} dump")
+        header, rows = lines[0], lines[1:]
+        fields = ["period"] + list(header["fields"])
+        Frames = namedtuple("RecordedFrames", fields)
+        return header, Frames(*(
+            np.asarray([row.get(name, 0) for row in rows], np.int64)
+            for name in fields))

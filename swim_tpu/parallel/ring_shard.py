@@ -75,6 +75,7 @@ except ImportError:                              # pragma: no cover
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.models import ring
+from swim_tpu.obs.engine import EngineFrame, frame_from_tap
 from swim_tpu.ops import wavepack
 from swim_tpu.parallel import mesh as pmesh
 from swim_tpu.sim.faults import FaultPlan
@@ -126,6 +127,9 @@ class ShardOps:
     # -- reductions -------------------------------------------------------
     def gsum(self, partial):
         return jax.lax.psum(partial, AXIS)
+
+    def gmax(self, partial):
+        return jax.lax.pmax(partial, AXIS)
 
     # -- communication ----------------------------------------------------
     def _rot(self, x, k_static: int):
@@ -398,16 +402,33 @@ def mapped_step(cfg: SwimConfig, mesh):
     study runner passes it to run_study_ring).  Memoized per
     (cfg, mesh): callers pass it as a STATIC jit argument, and a fresh
     closure per call would defeat the jit cache (one full study-scan
-    recompile per sweep point)."""
+    recompile per sweep point).
+
+    With cfg.telemetry the mapped step returns (state, EngineFrame):
+    the tap values are psum/pmax-reduced inside ring.step, so every
+    frame field is replicated — out_specs P() — and identical to the
+    single-program engine's frame for the same period."""
     d = _check(cfg, mesh)
 
-    def _step(state, plan, rnd):
-        return ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d))
+    if cfg.telemetry:
+        def _step(state, plan, rnd):
+            tap: dict = {}
+            st = ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d),
+                           tap=tap)
+            return st, frame_from_tap(tap)
+
+        out_specs = (_state_specs(cfg),
+                     EngineFrame(*(P() for _ in EngineFrame._fields)))
+    else:
+        def _step(state, plan, rnd):
+            return ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d))
+
+        out_specs = _state_specs(cfg)
 
     return shard_map(
         _step, mesh=mesh,
         in_specs=(_state_specs(cfg), _plan_specs(), _rnd_specs(cfg)),
-        out_specs=_state_specs(cfg), check_rep=False)
+        out_specs=out_specs, check_rep=False)
 
 
 def build_step(cfg: SwimConfig, mesh):
@@ -417,15 +438,22 @@ def build_step(cfg: SwimConfig, mesh):
 
 def build_run(cfg: SwimConfig, mesh, periods: int):
     """jitted run(state, plan, root_key): `periods` under one lax.scan,
-    randomness drawn inside the scan exactly as ring.run does."""
+    randomness drawn inside the scan exactly as ring.run does.
+
+    With cfg.telemetry returns (state, EngineFrame) where every frame
+    field is a [periods]-stacked i32 series (the flight-recorder feed);
+    otherwise just the final state."""
     sm = mapped_step(cfg, mesh)
 
     def run(state, plan, root_key):
         def body(stt, _):
             rnd = ring.draw_period_ring(root_key, stt.step, cfg)
-            return sm(stt, plan, rnd), None
+            out = sm(stt, plan, rnd)
+            if cfg.telemetry:
+                return out
+            return out, None
 
-        out, _ = jax.lax.scan(body, state, None, length=periods)
-        return out
+        out, frames = jax.lax.scan(body, state, None, length=periods)
+        return (out, frames) if cfg.telemetry else out
 
     return jax.jit(run)

@@ -21,7 +21,7 @@ milestones are one scatter over the (tiny) rumor table.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -29,6 +29,7 @@ import numpy as np
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.models import dense
+from swim_tpu.obs.engine import frame_from_tap
 from swim_tpu.ops import lattice
 from swim_tpu.sim.faults import FaultPlan
 from swim_tpu.utils.prng import draw_period
@@ -57,6 +58,8 @@ class StudyResult(NamedTuple):
     state: dense.DenseState
     track: StudyTrack
     series: PeriodSeries
+    # [periods]-stacked obs.engine.EngineFrame when cfg.telemetry, else None
+    telemetry: Any = None
 
 
 def _update_track(track: StudyTrack, state: dense.DenseState,
@@ -93,7 +96,13 @@ def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
     def body(carry, _):
         st, track = carry
         rnd = draw_period(root_key, st.step, cfg)
-        st = dense.step(cfg, st, plan, rnd)
+        if cfg.telemetry:
+            tap: dict = {}
+            st = dense.step(cfg, st, plan, rnd, tap=tap)
+            frame = frame_from_tap(tap)
+        else:
+            st = dense.step(cfg, st, plan, rnd)
+            frame = None
         # metrics observe the post-step state at time st.step - 1 = the
         # period just executed
         t = st.step - 1
@@ -110,17 +119,19 @@ def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
             jnp.sum(dead & live_col & live_row).astype(jnp.int32),
             jnp.max(lattice.incarnation_of(st.key)).astype(jnp.int32),
         )
-        return (st, track), series
+        return (st, track), (series, frame)
 
-    (state, track), series = jax.lax.scan(body, (state, track0), None,
-                                          length=periods)
-    return StudyResult(state, track, PeriodSeries(*series))
+    (state, track), (series, frames) = jax.lax.scan(
+        body, (state, track0), None, length=periods)
+    return StudyResult(state, track, PeriodSeries(*series), frames)
 
 
 class RumorStudyResult(NamedTuple):
     state: "rumor.RumorState"
     track: StudyTrack
     series: PeriodSeries
+    # [periods]-stacked obs.engine.EngineFrame when cfg.telemetry, else None
+    telemetry: Any = None
 
 
 def _subject_flags(n: int, subject, rkey, knowers, up,
@@ -180,7 +191,10 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
                     step_fn=None) -> RumorStudyResult:
     """Rumor-engine study. `step_fn(state, plan, rnd)` overrides the step
     (static arg) — used to run the explicitly-sharded engine
-    (swim_tpu/parallel/shard_engine.build_step) under the same metrics."""
+    (swim_tpu/parallel/shard_engine.build_step) under the same metrics.
+
+    With cfg.telemetry an override step_fn must return (state,
+    EngineFrame) — the contract ring_shard.mapped_step follows."""
     from swim_tpu.models import rumor as rumor_mod
 
     n = cfg.n_nodes
@@ -190,8 +204,16 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
     def body(carry, _):
         st, track = carry
         rnd = rumor_mod.draw_period_rumor(root_key, st.step, cfg)
+        frame = None
         if step_fn is None:
-            st = rumor_mod.step(cfg, st, plan, rnd)
+            if cfg.telemetry:
+                tap: dict = {}
+                st = rumor_mod.step(cfg, st, plan, rnd, tap=tap)
+                frame = frame_from_tap(tap)
+            else:
+                st = rumor_mod.step(cfg, st, plan, rnd)
+        elif cfg.telemetry:
+            st, frame = step_fn(st, plan, rnd)
         else:
             st = step_fn(st, plan, rnd)
         t = st.step - 1
@@ -216,17 +238,19 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
                   jnp.maximum(
                       jnp.max(lattice.incarnation_of(st.rkey)),
                       jnp.max(st.inc_self)).astype(jnp.int32))
-        return (st, track), series
+        return (st, track), (series, frame)
 
-    (state, track), series = jax.lax.scan(body, (state, track0), None,
-                                          length=periods)
-    return RumorStudyResult(state, track, PeriodSeries(*series))
+    (state, track), (series, frames) = jax.lax.scan(
+        body, (state, track0), None, length=periods)
+    return RumorStudyResult(state, track, PeriodSeries(*series), frames)
 
 
 class RingStudyResult(NamedTuple):
     state: "ring.RingState"
     track: StudyTrack
     series: PeriodSeries
+    # [periods]-stacked obs.engine.EngineFrame when cfg.telemetry, else None
+    telemetry: Any = None
 
 
 # `state` is donated in all three study runners: every caller builds it
@@ -244,6 +268,8 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
     `step_fn(state, plan, rnd)` overrides the stepper — the explicitly-
     sharded engine passes `ring_shard.mapped_step(cfg, mesh)` so studies
     run on the collective-permute path; metrics stay GSPMD-partitioned.
+    With cfg.telemetry an override step_fn must return (state,
+    EngineFrame) — which ring_shard.mapped_step does automatically.
 
     Per-slot knower COUNTS require unpacking the bit-planes ([N, R] work
     per period), which is fine at study sizes; the throughput bench path
@@ -262,8 +288,16 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
     def body(carry, _):
         st, track = carry
         rnd = ring_mod.draw_period_ring(root_key, st.step, cfg)
+        frame = None
         if step_fn is None:
-            st = ring_mod.step(cfg, st, plan, rnd)
+            if cfg.telemetry:
+                tap: dict = {}
+                st = ring_mod.step(cfg, st, plan, rnd, tap=tap)
+                frame = frame_from_tap(tap)
+            else:
+                st = ring_mod.step(cfg, st, plan, rnd)
+        elif cfg.telemetry:
+            st, frame = step_fn(st, plan, rnd)
         else:
             st = step_fn(st, plan, rnd)
         t = st.step - 1
@@ -298,11 +332,11 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
             jnp.maximum(jnp.max(lattice.incarnation_of(st.rkey)),
                         jnp.max(st.inc_self)).astype(jnp.int32),
         )
-        return (st, track), series
+        return (st, track), (series, frame)
 
-    (state, track), series = jax.lax.scan(body, (state, track0), None,
-                                          length=periods)
-    return RingStudyResult(state, track, PeriodSeries(*series))
+    (state, track), (series, frames) = jax.lax.scan(
+        body, (state, track0), None, length=periods)
+    return RingStudyResult(state, track, PeriodSeries(*series), frames)
 
 
 def detection_summary(result: StudyResult, plan: FaultPlan,

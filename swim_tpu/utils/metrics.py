@@ -42,10 +42,21 @@ def aggregate_nodes(nodes: Iterable[Any]) -> dict[str, Any]:
 
 
 def series_digest(series: Any) -> dict[str, Any]:
-    """Compact summary of a runner.PeriodSeries (works for both engines)."""
+    """Compact summary of a per-period series NamedTuple (engine
+    PeriodSeries, telemetry EngineFrame stacks, re-read flight-recorder
+    frames — anything with `_fields` of per-period arrays).
+
+    Emits `_final`/`_peak` (stable keys, consumed by sim/experiments)
+    plus `_sum`/`_mean`.  Integer series digest to int, float-dtype
+    series keep their values undamaged (no lossy int() cast); `_mean`
+    is always a float.
+    """
     out: dict[str, Any] = {}
     for name in series._fields:
         arr = np.asarray(getattr(series, name))
-        out[f"{name}_final"] = int(arr[-1]) if arr.size else 0
-        out[f"{name}_peak"] = int(arr.max()) if arr.size else 0
+        cast = float if np.issubdtype(arr.dtype, np.floating) else int
+        out[f"{name}_final"] = cast(arr[-1]) if arr.size else 0
+        out[f"{name}_peak"] = cast(arr.max()) if arr.size else 0
+        out[f"{name}_sum"] = cast(arr.sum()) if arr.size else 0
+        out[f"{name}_mean"] = float(arr.mean()) if arr.size else 0.0
     return out

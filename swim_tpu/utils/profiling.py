@@ -44,16 +44,20 @@ class StepTimer:
 
     @contextlib.contextmanager
     def lap(self, periods: int, result: Any = None):
+        """Time one lap of `periods` protocol periods.
+
+        Only COMPLETED laps count: a body that raises contributes neither
+        periods nor seconds (the old `finally` accounting credited the
+        periods of a failed lap, silently inflating periods_per_sec).
+        """
         t0 = time.perf_counter()
         holder = {}
-        try:
-            yield holder
-        finally:
-            out = holder.get("result", result)
-            if out is not None:
-                jax.block_until_ready(out)
-            self.seconds += time.perf_counter() - t0
-            self.periods += periods
+        yield holder
+        out = holder.get("result", result)
+        if out is not None:
+            jax.block_until_ready(out)
+        self.seconds += time.perf_counter() - t0
+        self.periods += periods
 
     @property
     def periods_per_sec(self) -> float:

@@ -161,7 +161,9 @@ def test_series_digest():
 
     S = collections.namedtuple("S", ["a", "b"])
     d = metrics.series_digest(S(np.array([1, 5, 2]), np.array([], np.int32)))
-    assert d == {"a_final": 2, "a_peak": 5, "b_final": 0, "b_peak": 0}
+    assert d == {"a_final": 2, "a_peak": 5, "a_sum": 8,
+                 "a_mean": pytest.approx(8 / 3),
+                 "b_final": 0, "b_peak": 0, "b_sum": 0, "b_mean": 0.0}
 
 
 def test_step_timer_and_trace(tmp_path):

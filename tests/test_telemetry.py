@@ -1,0 +1,376 @@
+"""Unified telemetry layer (swim_tpu/obs/): engine tap parity + frame
+sanity, flight-recorder JSONL round trip, typed registry + Prometheus
+exposition, probe-lifecycle tracing, the bridge /metrics endpoint, and
+the StepTimer / series_digest satellite fixes.
+
+The load-bearing guarantee is the FIRST class: telemetry collection may
+never change a protocol bit.  The tap is structural — `tap=None` leaves
+the traced program byte-identical — and these tests pin the equality
+empirically for every engine (the sharded tri-run lives in
+tests/test_ring_shard.py).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.obs.engine import EngineFrame, frame_from_tap
+from swim_tpu.sim import faults
+
+SMALL = dict(suspicion_mult=1.0, k_indirect=1, max_piggyback=2,
+             ring_window_periods=2, ring_view_c=2)
+
+
+def _crashy_plan(n):
+    return faults.with_loss(
+        faults.with_crashes(faults.none(n), [3, n - 5], [2, 5]), 0.05)
+
+
+def _draw_for(engine):
+    from swim_tpu.models import ring, rumor
+    from swim_tpu.utils.prng import draw_period
+
+    return {"ring": ring.draw_period_ring,
+            "rumor": rumor.draw_period_rumor,
+            "dense": draw_period}[engine]
+
+
+def _run_steps(step, cfg, state, plan, periods, seed, tap_out=None,
+               engine="ring"):
+    """Step an engine `periods` times; collect frames when tap_out given."""
+    draw = _draw_for(engine)
+    key = jax.random.key(seed)
+    for t in range(periods):
+        rnd = draw(key, t, cfg)
+        if tap_out is None:
+            state = step(cfg, state, plan, rnd)
+        else:
+            tap: dict = {}
+            state = step(cfg, state, plan, rnd, tap=tap)
+            tap_out.append(frame_from_tap(tap))
+    return state
+
+
+class TestEngineTapParity:
+    """Telemetry on/off: protocol state stays bitwise identical."""
+
+    @pytest.mark.parametrize("engine", ["ring", "rumor", "dense"])
+    def test_state_parity(self, engine):
+        from swim_tpu.models import dense, ring, rumor
+
+        mod = {"ring": ring, "rumor": rumor, "dense": dense}[engine]
+        n = 64
+        kw = SMALL if engine == "ring" else {}
+        cfg = SwimConfig(n_nodes=n, **kw)
+        plan = _crashy_plan(n)
+        off = _run_steps(mod.step, cfg, mod.init_state(cfg), plan, 10, 3,
+                         engine=engine)
+        frames: list = []
+        on = _run_steps(mod.step, cfg, mod.init_state(cfg), plan, 10, 3,
+                        tap_out=frames, engine=engine)
+        for name in off._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(off, name)),
+                np.asarray(getattr(on, name)), err_msg=f"{engine}:{name}")
+        assert len(frames) == 10
+
+    def test_ring_frame_sane(self):
+        from swim_tpu.models import ring
+
+        n = 64
+        cfg = SwimConfig(n_nodes=n, **SMALL)
+        plan = faults.with_crashes(faults.none(n), [3], [2])
+        frames: list = []
+        _run_steps(ring.step, cfg, ring.init_state(cfg), plan, 8, 7,
+                   tap_out=frames)
+        stacked = EngineFrame(*(np.asarray([getattr(f, name)
+                                            for f in frames])
+                                for name in EngineFrame._fields))
+        b = min(cfg.max_piggyback, ring.geometry(cfg).ww * 32)
+        assert stacked.sel_slots_max.max() <= b
+        assert (stacked.sel_slots_selected <= stacked.win_occupancy).all()
+        assert (stacked.sel_rows_saturated <= n).all()
+        # a crash at period 2 means waves flow and probes eventually fail
+        assert stacked.waves_delivered.sum() > 0
+        assert stacked.probes_failed.sum() > 0
+        assert stacked.overflow.max() == 0
+
+    def test_recorded_ring_run_matches_ring_run(self):
+        """The bench on-arm (recorded_ring_run) reproduces ring.run's
+        final state bitwise AND stacks [T] frames as scan ys."""
+        from swim_tpu.models import ring
+        from swim_tpu.obs.engine import recorded_ring_run
+
+        n = 64
+        cfg = SwimConfig(n_nodes=n, **SMALL)
+        cfg_on = cfg.replace(telemetry=True)
+        plan = _crashy_plan(n)
+        key = jax.random.key(5)
+        ref = ring.run(cfg, ring.init_state(cfg), plan, key, 9)
+        rec = recorded_ring_run(cfg_on, ring.init_state(cfg_on), plan,
+                                key, 9)
+        for name in ref._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, name)),
+                np.asarray(getattr(rec.state, name)), err_msg=name)
+        assert int(rec.step) == int(ref.step)       # bench execution proof
+        assert np.asarray(rec.frames.waves_delivered).shape == (9,)
+
+
+class TestStudyPath:
+    def test_detection_study_telemetry_digest_and_dump(self, tmp_path):
+        """cfg.telemetry through the study runner: digest keys appear,
+        on-demand flight record is written and re-loadable."""
+        from swim_tpu.obs.recorder import FlightRecorder
+        from swim_tpu.sim import experiments
+
+        path = str(tmp_path / "fr.jsonl")
+        out = experiments.detection_study(n=128, periods=16, engine="ring",
+                                          telemetry=True,
+                                          flight_record=path, **SMALL)
+        assert "telemetry" in out
+        assert "waves_delivered_sum" in out["telemetry"]
+        assert out["flight_record"] == path
+        header, frames = FlightRecorder.load(path)
+        assert header["reason"] in ("on_demand", "anomaly")
+        assert header["periods"] == 16
+        assert len(frames.period) == 16
+
+    def test_telemetry_off_is_default(self):
+        from swim_tpu.sim import experiments
+
+        out = experiments.detection_study(n=128, periods=8, engine="ring",
+                                          **SMALL)
+        assert "telemetry" not in out
+        assert "flight_record" not in out
+
+
+class TestFlightRecorder:
+    def test_round_trip_digest(self, tmp_path):
+        from swim_tpu.obs.recorder import FlightRecorder
+        from swim_tpu.utils import metrics
+
+        rec = FlightRecorder(capacity=4)
+        for t in range(6):          # overflows: keeps the LAST 4
+            rec.record(t, {"waves_delivered": 10 * t, "probes_failed": 1})
+        assert len(rec) == 4
+        path = rec.dump(str(tmp_path / "f.jsonl"), reason="anomaly")
+        header, frames = FlightRecorder.load(path)
+        assert header["kind"] == "swim_tpu_flight_recorder"
+        assert header["reason"] == "anomaly"
+        assert list(frames.period) == [2, 3, 4, 5]
+        d = metrics.series_digest(frames)
+        assert d["waves_delivered_peak"] == 50
+        assert d["waves_delivered_final"] == 50
+        assert d["probes_failed_sum"] == 4
+
+    def test_header_embeds_cfg_and_ici(self, tmp_path):
+        from swim_tpu.obs.ici import trace_ici_bytes
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        cfg = SwimConfig(n_nodes=256, **SMALL)
+        ici = trace_ici_bytes(cfg, 8)
+        rec = FlightRecorder(cfg=cfg, capacity=2, ici_bytes=ici)
+        rec.record(0, {})
+        path = rec.dump(str(tmp_path / "f.jsonl"))
+        header, _ = FlightRecorder.load(path)
+        assert header["cfg"]["n_nodes"] == 256
+        assert header["ici_bytes"]["per_chip_bytes_per_period"] > 0
+        assert header["ici_bytes"]["ici_ceiling_pps"] > 0
+        assert "psum_scalar" in header["ici_bytes"]["breakdown"]
+
+    def test_load_rejects_foreign_jsonl(self, tmp_path):
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        p = tmp_path / "x.jsonl"
+        p.write_text('{"kind": "something_else"}\n')
+        with pytest.raises(ValueError, match="flight_recorder"):
+            FlightRecorder.load(str(p))
+
+
+class TestRegistryAndExposition:
+    def test_undeclared_counter_raises(self):
+        from swim_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry.node_default()
+        stats = reg.stats_view()
+        stats["probes"] += 2
+        assert reg.counter("probes").value == 2
+        with pytest.raises(KeyError, match="not declared"):
+            stats["typo_counter"] += 1
+
+    def test_histogram_buckets(self):
+        from swim_tpu.obs.registry import Histogram
+
+        h = Histogram("x_seconds", "help", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 0.5, 5.0):
+            h.observe(v)
+        assert h.counts == [1, 2, 1]
+        assert h.cumulative() == [1, 3, 4]
+        assert h.count == 4 and h.sum == pytest.approx(6.05)
+        with pytest.raises(ValueError, match="sorted"):
+            Histogram("bad", "help", buckets=(1.0, 0.1))
+
+    def test_prometheus_rendering(self):
+        from swim_tpu.obs.expo import render_prometheus
+        from swim_tpu.obs.registry import MetricsRegistry
+
+        a, b = (MetricsRegistry.node_default() for _ in range(2))
+        a.counter("probes").inc(3)
+        b.counter("probes").inc(1)
+        a.observe("probe_rtt_seconds", 0.02)
+        text = render_prometheus([({"node": "0"}, a), ({"node": "1"}, b)])
+        assert "# HELP swim_probes_total" in text
+        assert "# TYPE swim_probes_total counter" in text
+        assert text.count("# HELP swim_probes_total") == 1   # once, not per node
+        assert 'swim_probes_total{node="0"} 3' in text
+        assert 'swim_probes_total{node="1"} 1' in text
+        assert 'swim_probe_rtt_seconds_bucket{node="0",le="0.025"} 1' in text
+        assert 'swim_probe_rtt_seconds_bucket{node="0",le="+Inf"} 1' in text
+        assert 'swim_probe_rtt_seconds_count{node="0"} 1' in text
+
+    def test_registry_lint_script(self):
+        r = subprocess.run(
+            [sys.executable, "scripts/check_metrics_registry.py"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
+
+
+class TestNodeTracing:
+    def test_cluster_emits_probe_and_suspicion_spans(self):
+        from swim_tpu.core.cluster import SimCluster
+        from swim_tpu.obs.trace import ListSink
+
+        sink = ListSink()
+        c = SimCluster(SwimConfig(n_nodes=12, k_indirect=3,
+                                  protocol_period=1.0), seed=4, trace=sink)
+        c.start()
+        c.run(5.0)
+        c.kill(7)
+        c.run(20.0)
+        kinds = {s.kind for s in sink.spans}
+        assert kinds == {"probe", "suspicion"}
+        probe_outcomes = {s.outcome for s in sink.spans
+                          if s.kind == "probe"}
+        assert "ack" in probe_outcomes and "fail" in probe_outcomes
+        susp = [s for s in sink.spans if s.kind == "suspicion"]
+        assert any(s.subject == 7 and s.outcome == "confirmed"
+                   for s in susp)
+        for s in sink.spans:
+            assert s.end is not None and s.end >= s.start
+
+    def test_jsonl_sink_and_rtt_histogram(self, tmp_path):
+        from swim_tpu.core.cluster import SimCluster
+        from swim_tpu.obs.trace import JsonlSink
+
+        path = tmp_path / "spans.jsonl"
+        sink = JsonlSink(str(path))
+        c = SimCluster(SwimConfig(n_nodes=8, protocol_period=1.0),
+                       seed=2, trace=sink)
+        c.start()
+        c.run(8.0)
+        sink.close()
+        rows = [json.loads(line) for line in path.read_text().splitlines()]
+        assert rows and all(r["kind"] in ("probe", "suspicion")
+                            for r in rows)
+        ping_events = [e for r in rows for e in r["events"]
+                       if e[1] == "ping"]
+        assert ping_events
+        # acked probes observed into the RTT histogram
+        h = c.nodes[0].registry.histogram("probe_rtt_seconds")
+        assert h.count > 0 and h.sum > 0
+
+    def test_tracing_off_by_default_zero_cost_path(self):
+        from swim_tpu.core.cluster import SimCluster
+
+        c = SimCluster(SwimConfig(n_nodes=6, protocol_period=1.0), seed=1)
+        c.start()
+        c.run(5.0)
+        assert all(n.trace is None for n in c.nodes)
+        assert c.nodes[0].stats["probes"] > 0   # registry still counts
+
+
+class TestBridgeMetricsEndpoint:
+    def test_metrics_http_exposition(self):
+        from swim_tpu.bridge import BridgeServer
+
+        cfg = SwimConfig(n_nodes=4, protocol_period=1.0)
+        server = BridgeServer(cfg, n_internal=4, seed=6, metrics_port=0)
+        try:
+            server.start()
+            server.clock.advance(5.0)
+            host, port = server.metrics_address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/metrics", timeout=5) as resp:
+                assert resp.status == 200
+                assert "version=0.0.4" in resp.headers["Content-Type"]
+                body = resp.read().decode()
+            assert "# TYPE swim_probes_total counter" in body
+            assert 'swim_probes_total{node="0"}' in body
+            assert 'swim_messages_out_total{node="3"}' in body
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/nope", timeout=5)
+        finally:
+            server.close()
+
+    def test_metrics_endpoint_off_by_default(self):
+        from swim_tpu.bridge import BridgeServer
+
+        server = BridgeServer(SwimConfig(n_nodes=4), n_internal=2, seed=1)
+        try:
+            assert server.metrics_address is None
+        finally:
+            server.close()
+
+
+class TestSatelliteFixes:
+    def test_step_timer_failed_lap_counts_nothing(self):
+        from swim_tpu.utils import profiling
+
+        timer = profiling.StepTimer()
+        with pytest.raises(RuntimeError):
+            with timer.lap(periods=50):
+                raise RuntimeError("dispatch blew up")
+        assert timer.periods == 0
+        assert timer.seconds == 0.0
+        assert timer.periods_per_sec == 0.0
+        with timer.lap(periods=10) as h:
+            h["result"] = jax.numpy.arange(4)
+        assert timer.periods == 10
+
+    def test_series_digest_float_dtypes(self):
+        import collections
+
+        from swim_tpu.utils import metrics
+
+        S = collections.namedtuple("S", ["lat"])
+        d = metrics.series_digest(S(np.array([0.25, 1.5, 0.75])))
+        assert d["lat_final"] == pytest.approx(0.75)    # not int-truncated
+        assert d["lat_peak"] == pytest.approx(1.5)
+        assert d["lat_sum"] == pytest.approx(2.5)
+        assert d["lat_mean"] == pytest.approx(2.5 / 3)
+        assert isinstance(d["lat_final"], float)
+
+
+class TestBenchArm:
+    def test_bench_telemetry_overhead_smoke(self):
+        """The overhead arm runs end-to-end at tiny size and reports the
+        contract fields.  The <=5% number itself is pinned by the real
+        bench artifact (bench_results/telemetry_overhead.json), not by
+        this smoke — CPU timing jitter at toy N is not the contract."""
+        import bench
+
+        res = bench.bench_telemetry_overhead(512, 6, warmup=1, reps=2)
+        assert res["pps_off"] > 0 and res["pps_on"] > 0
+        assert "overhead_pct" in res and res["contract_pct"] == 5.0
+        assert res["anchor_cfg"]["ring_sel_scope"] == "period"

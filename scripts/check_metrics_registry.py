@@ -9,6 +9,12 @@ collects every string literal used to subscript `self.stats`, and exits
 non-zero if any is missing from NODE_COUNTERS (or if a subscript key is
 not a plain string literal, which the view cannot type).
 
+Also lints the health-gauge surface: every rule in
+swim_tpu/obs/health.py HEALTH_RULES must be a legal Prometheus metric
+name suffix with a known severity, and `render_health` must emit exactly
+{swim_health_<rule>} ∪ {swim_health_status} — so the gauge names on the
+bridge's /metrics never drift from the rule table docs/dashboards key on.
+
 Run directly (`python scripts/check_metrics_registry.py`) or via the
 fast tier-1 test that shells out to it (tests/test_telemetry.py).
 """
@@ -46,6 +52,37 @@ def stats_keys(path: str = NODE_PY) -> tuple[set[str], list[str]]:
     return keys, dynamic
 
 
+def check_health_gauges() -> list[str]:
+    """Problems with the swim_health_* gauge surface ([] = clean)."""
+    import re
+
+    from swim_tpu.obs.expo import render_health
+    from swim_tpu.obs.health import HEALTH_RULES, SEVERITIES
+
+    problems: list[str] = []
+    # metric-name charset minus a leading digit; the full name is
+    # swim_health_<rule>, so the rule itself must match [a-z0-9_]+
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for rule, (severity, _help) in HEALTH_RULES.items():
+        if not name_re.match(rule):
+            problems.append(f"rule {rule!r} is not a legal Prometheus "
+                            "metric-name suffix")
+        if severity not in SEVERITIES:
+            problems.append(f"rule {rule!r} has unknown severity "
+                            f"{severity!r} (expected one of {SEVERITIES})")
+    expected = {f"swim_health_{r}" for r in HEALTH_RULES}
+    expected.add("swim_health_status")
+    emitted = {line.split("{")[0].split(" ")[0]
+               for line in render_health([]).splitlines()
+               if line and not line.startswith("#")}
+    if emitted != expected:
+        problems.append(
+            f"render_health emits {sorted(emitted)} but the rule table "
+            f"implies {sorted(expected)} — keep HEALTH_RULES and "
+            "render_health in lockstep")
+    return problems
+
+
 def main() -> int:
     from swim_tpu.obs.registry import NODE_COUNTERS
 
@@ -67,8 +104,15 @@ def main() -> int:
         # counters may be bumped outside node.py (tests, future callers)
         print(f"note: declared counters not incremented in node.py: "
               f"{unused}", file=sys.stderr)
+    health_problems = check_health_gauges()
+    for problem in health_problems:
+        ok = False
+        print(f"health-gauge lint: {problem}", file=sys.stderr)
+    from swim_tpu.obs.health import HEALTH_RULES
+
     print(f"checked {len(keys)} stats keys against "
-          f"{len(NODE_COUNTERS)} declared counters: "
+          f"{len(NODE_COUNTERS)} declared counters and "
+          f"{len(HEALTH_RULES)} health gauges: "
           f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
 

@@ -17,8 +17,11 @@ Usage: python scripts/run_suite.py [--timeout-per-file S]
          [--artifacts-dir DIR] [pattern]
 Exit 0 iff every file's pytest exited 0.  `--artifacts-dir DIR` copies
 the run's telemetry/bench artifacts (bench_results/*.json, any
-*flight_record*.jsonl the tests left behind) into DIR afterwards and
-prints the inventory — the collection a CI job would upload.
+*flight_record*.jsonl the tests left behind) into DIR afterwards,
+prints the inventory, and runs the obs analyzers (swim_tpu/obs/analyze)
+over every captured .jsonl — an error-severity health finding in any
+artifact fails the run, so CI gates on protocol health, not just on
+assertions.
 """
 from __future__ import annotations
 
@@ -32,6 +35,37 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def analyze_artifacts(dest: str) -> list[str]:
+    """Run the obs analyzers over every .jsonl artifact in `dest`.
+
+    Returns formatted error-severity findings (empty = healthy).  Prints
+    one summary line per artifact.  jax-free: swim_tpu.obs.analyze
+    imports only json+numpy, so this adds no JAX startup to the runner.
+    """
+    from swim_tpu.obs import analyze
+
+    errors: list[str] = []
+    for path in sorted(glob.glob(os.path.join(dest, "*.jsonl"))):
+        name = os.path.basename(path)
+        try:
+            report = analyze.analyze(path)
+        except (ValueError, OSError, KeyError) as e:
+            # Unanalyzable telemetry a test left behind is a real defect
+            # in the capture pipeline, not noise to skip past.
+            errors.append(f"{name}: unanalyzable ({e})")
+            print(f"  ANALYZE FAIL {name}: {e}", flush=True)
+            continue
+        worst = (report.get("health") or {}).get("worst", "ok")
+        kind = report.get("kind", "?")
+        print(f"  analyzed {name:40s} kind={kind} health={worst}",
+              flush=True)
+        for f in analyze.error_findings(report):
+            errors.append(f"{name}: [{f['severity']}] {f['rule']}: "
+                          f"{f['message']}")
+    return errors
 
 
 def collect_artifacts(dest: str) -> list[str]:
@@ -125,6 +159,13 @@ def main() -> int:
         print(f"artifacts -> {args.artifacts_dir} ({len(copied)}):")
         for rel in copied:
             print(f"  {rel}")
+        errors = analyze_artifacts(args.artifacts_dir)
+        if errors:
+            print(f"ERROR-severity health findings in {len(errors)} "
+                  "artifact(s):", file=sys.stderr)
+            for line in errors:
+                print(f"  {line}", file=sys.stderr)
+            return 1
     return 1 if failures else 0
 
 

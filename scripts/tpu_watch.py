@@ -44,6 +44,37 @@ def probe(timeout: float = 120.0) -> bool:
         return False
 
 
+def _attach_analysis(payload) -> dict | None:
+    """Best-effort obs analyzer summary for a capture's flight record.
+
+    A detection-study payload that dumped telemetry carries the dump
+    path under "flight_record"; replay it through swim_tpu.obs.analyze
+    (jax-free, so cheap in the watcher) and return a compact summary so
+    the captured artifact is self-describing about protocol health.
+    Never fails the capture: the analysis rides along or it doesn't.
+    """
+    if not isinstance(payload, dict):
+        return None
+    path = payload.get("flight_record")
+    if not isinstance(path, str):
+        return None
+    if not os.path.isabs(path):
+        path = os.path.join(REPO, path)
+    try:
+        from swim_tpu.obs import analyze
+
+        report = analyze.analyze(path)
+        return {
+            "health": report.get("health"),
+            "detection": report.get("detection"),
+            "detection_law": report.get("detection_law"),
+            "dissemination": report.get("dissemination"),
+            "piggyback": report.get("piggyback"),
+        }
+    except Exception as e:  # noqa: BLE001 — attachment is best-effort
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def run_save(name: str, cmd: list[str], timeout: float,
              check=None) -> bool | None:
     print(f"[tpu_watch] running {name}: {' '.join(cmd)}", flush=True)
@@ -63,12 +94,15 @@ def run_save(name: str, cmd: list[str], timeout: float,
             continue
     final = os.path.join(OUT, f"{name}.json")
     tmp = final + ".tmp"
+    record = {"cmd": cmd, "rc": r.returncode, "result": payload,
+              "stdout_tail": (r.stdout or "")[-6000:],
+              "stderr_tail": (r.stderr or "")[-2000:],
+              "captured_at": time.strftime("%Y-%m-%d %H:%M:%S")}
+    analysis = _attach_analysis(payload)
+    if analysis is not None:
+        record["analysis"] = analysis
     with open(tmp, "w") as f:
-        json.dump({"cmd": cmd, "rc": r.returncode, "result": payload,
-                   "stdout_tail": (r.stdout or "")[-6000:],
-                   "stderr_tail": (r.stderr or "")[-2000:],
-                   "captured_at": time.strftime("%Y-%m-%d %H:%M:%S")},
-                  f, indent=1)
+        json.dump(record, f, indent=1)
     os.replace(tmp, final)
     ok = r.returncode == 0 and payload is not None
     if ok and check is not None and not check(payload):
@@ -144,11 +178,14 @@ CAPTURES: list = [
       "--periods", "8", "--tier-timeout", "1500"], 1800, False,
      lambda p: p.get("platform") not in (None, "cpu")),
     # Detection law beyond the XLA-CPU envelope (which aborts at 8M):
-    # pull-probe ring engine at 10M on real hardware.
+    # pull-probe ring engine at 10M on real hardware.  The flight-record
+    # dump lets _attach_analysis enrich the capture with the offline
+    # analyzer report (detection law, health, piggyback pressure).
     ("study_detection_10m",
      ["-m", "swim_tpu.cli", "study", "detection", "--nodes", "10000000",
       "--engine", "ring", "--periods", "12",
-      "--crash-fraction", "0.00001"], 3600, False, None),
+      "--crash-fraction", "0.00001", "--telemetry", "--flight-record",
+      "bench_results/detection_10m_flight.jsonl"], 3600, False, None),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

@@ -27,10 +27,14 @@ from swim_tpu.core.transport import Address, InProcessTransport, SimNetwork
 
 
 def _make_metrics_server(host: str, port: int, nodes: list[Node]):
-    """Stdlib HTTP server exposing GET /metrics (Prometheus text 0.0.4)."""
+    """Stdlib HTTP server exposing GET /metrics (Prometheus text 0.0.4):
+    per-node typed registries, a `swim_build_info` gauge, and the
+    current `swim_health_*` gauges (obs/health.py real-node rules
+    evaluated per scrape — `swim-tpu observe URL --follow` tails this)."""
     import http.server
 
-    from swim_tpu.obs.expo import render_prometheus
+    from swim_tpu.obs.expo import render_health, render_prometheus
+    from swim_tpu.obs.health import evaluate_registries
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):                                  # noqa: N802
@@ -38,7 +42,10 @@ def _make_metrics_server(host: str, port: int, nodes: list[Node]):
                 self.send_error(404)
                 return
             body = render_prometheus(
-                ({"node": str(n.id)}, n.registry) for n in nodes)
+                (({"node": str(n.id)}, n.registry) for n in nodes),
+                build_labels={"nodes": str(len(nodes))})
+            body += render_health(
+                evaluate_registries(n.registry for n in nodes))
             data = body.encode()
             self.send_response(200)
             self.send_header("Content-Type",

@@ -7,6 +7,9 @@ Subcommands:
               with optional kills, loss, and partition injection
   simulate  — the vectorized TPU engine: N up to millions, faults as
               tensors, metrics as JSON
+  observe   — analyze telemetry artifacts offline (flight-recorder
+              dumps, trace-span JSONL) or tail a live dump / a
+              /metrics URL as a refreshing terminal view
 """
 
 from __future__ import annotations
@@ -254,6 +257,104 @@ def _cmd_study(args: argparse.Namespace) -> int:
     return 0
 
 
+def _scrape_metrics(url: str) -> dict:
+    """One GET of a Prometheus /metrics endpoint, reduced to the
+    swim_health_* gauge set and counter totals (summed across node
+    labels) — the live-view payload for `observe --follow URL`."""
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        text = resp.read().decode()
+    health: dict[str, float] = {}
+    counters: dict[str, float] = {}
+    build = ""
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name_labels, _, val = line.rpartition(" ")
+        name = name_labels.split("{", 1)[0]
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        if name.startswith("swim_health_"):
+            health[name[len("swim_health_"):]] = max(
+                v, health.get(name[len("swim_health_"):], 0.0))
+        elif name.endswith("_total"):
+            counters[name] = counters.get(name, 0.0) + v
+        elif name == "swim_build_info":
+            build = name_labels[len(name):]
+    report: dict = {"kind": "metrics_scrape", "url": url,
+                    "health": health, "counters": counters}
+    if build:
+        report["build_info"] = build
+    return report
+
+
+def _render_scrape(report: dict) -> str:
+    status = int(report["health"].get("status", 0))
+    lines = [f"metrics scrape · {report['url']}",
+             f"health: {('ok', 'warn', 'ERROR')[min(status, 2)]}"]
+    firing = [r for r, v in report["health"].items()
+              if r != "status" and v > 0]
+    for rule in firing:
+        lines.append(f"  firing: {rule}")
+    for name, v in sorted(report["counters"].items()):
+        lines.append(f"  {name} {int(v)}")
+    if report.get("build_info"):
+        lines.append(f"  build {report['build_info']}")
+    return "\n".join(lines)
+
+
+def _cmd_observe(args: argparse.Namespace) -> int:
+    import time
+
+    from swim_tpu.obs import analyze
+
+    is_url = (len(args.paths) == 1
+              and args.paths[0].startswith(("http://", "https://")))
+    if is_url and not args.follow and not args.json:
+        args.follow = True      # a bare URL is a live view by definition
+
+    def once() -> tuple[str, dict | None]:
+        if is_url:
+            report = _scrape_metrics(args.paths[0])
+            return ((json.dumps(report, indent=2) if args.json
+                     else _render_scrape(report)), report)
+        report = analyze.analyze_paths(args.paths, window=args.window)
+        return ((json.dumps(report, indent=2) if args.json
+                 else analyze.render_report(report)), report)
+
+    if not args.follow:
+        try:
+            text, report = once()
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(text)
+        if args.check and report is not None \
+                and not is_url and analyze.error_findings(report):
+            return 1
+        return 0
+
+    i = 0
+    while True:
+        try:
+            text, _ = once()
+        except (OSError, ValueError) as e:
+            text = f"(waiting: {e})"
+        # redraw-in-place: clear screen + home, like watch(1)
+        sys.stdout.write("\x1b[2J\x1b[H" + text + "\n")
+        sys.stdout.flush()
+        i += 1
+        if args.iterations and i >= args.iterations:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def _cmd_bridge(args: argparse.Namespace) -> int:
     from swim_tpu import SwimConfig
     from swim_tpu.bridge import BridgeServer
@@ -368,6 +469,28 @@ def build_parser() -> argparse.ArgumentParser:
                          "studies default to rotor.")
     st.set_defaults(fn=_cmd_study)
 
+    ob = sub.add_parser(
+        "observe", help="analyze telemetry artifacts (flight-recorder "
+                        "dump / trace-span JSONL) or tail a live dump "
+                        "or /metrics URL")
+    ob.add_argument("paths", nargs="+",
+                    help="recorder dump and/or span JSONL paths, or ONE "
+                         "http(s)://host:port/metrics URL")
+    ob.add_argument("--json", action="store_true",
+                    help="emit the raw analyzer report as JSON")
+    ob.add_argument("--follow", action="store_true",
+                    help="refreshing terminal view: re-analyze the "
+                         "file(s) or re-scrape the URL every --interval")
+    ob.add_argument("--interval", type=float, default=2.0)
+    ob.add_argument("--iterations", type=int, default=0,
+                    help="stop --follow after K refreshes (0 = until ^C)")
+    ob.add_argument("--window", type=int, default=16,
+                    help="health-rule sliding window, in periods")
+    ob.add_argument("--check", action="store_true",
+                    help="exit 1 if any error-severity health finding "
+                         "(CI gate)")
+    ob.set_defaults(fn=_cmd_observe)
+
     br = sub.add_parser(
         "bridge", help="serve a simulated cluster for an external core "
                        "(swim_tpu/bridge/protocol.py)")
@@ -393,7 +516,12 @@ def main(argv: list[str] | None = None) -> int:
         from swim_tpu.utils.platform import force_cpu
 
         force_cpu(8 if args.platform == "cpu8" else None)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `swim-tpu observe ... | head` closing the pipe is not an error
+        sys.stderr.close()
+        return 0
 
 
 if __name__ == "__main__":

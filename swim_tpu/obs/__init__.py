@@ -20,22 +20,47 @@ Two stacks, one subsystem:
   and `render_prometheus` is the text exposition served by the bridge
   server's `/metrics` endpoint.
 
+* **Analysis & health** — `analyze` computes the paper's protocol
+  metrics offline from recorded artifacts (detection-latency CDF vs
+  the e/(e−1) law, infection-curve progress, piggyback pressure, span
+  breakdowns); `HealthMonitor` is a sliding-window rules engine whose
+  severity-ranked `Finding`s drive flight-recorder auto-dumps and the
+  `swim_health_*` gauges (`render_health`).  `swim-tpu observe` is the
+  CLI face of both.
+
 See docs/OBSERVABILITY.md for knobs, schemas, and semantics.
 """
 
-from swim_tpu.obs.engine import (EngineFrame, RecordedRun, empty_frame,
-                                 frame_from_tap, recorded_ring_run)
-from swim_tpu.obs.ici import trace_ici_bytes
-from swim_tpu.obs.recorder import FlightRecorder
-from swim_tpu.obs.registry import (NODE_COUNTERS, NODE_HISTOGRAMS, Counter,
-                                   Histogram, MetricsRegistry)
-from swim_tpu.obs.trace import JsonlSink, ListSink, NullSink, Span, TraceSink
-from swim_tpu.obs.expo import render_prometheus
+import importlib
 
-__all__ = [
-    "EngineFrame", "RecordedRun", "empty_frame", "frame_from_tap",
-    "recorded_ring_run", "trace_ici_bytes", "FlightRecorder",
-    "NODE_COUNTERS", "NODE_HISTOGRAMS", "Counter", "Histogram",
-    "MetricsRegistry", "Span", "TraceSink", "NullSink", "ListSink",
-    "JsonlSink", "render_prometheus",
-]
+# Attribute -> submodule, resolved lazily (PEP 562).  The split matters
+# operationally: analyze/health/expo/registry/trace are json+numpy only,
+# so `from swim_tpu.obs import analyze` in host-side tooling
+# (scripts/run_suite.py artifact gating, scripts/tpu_watch.py capture
+# enrichment) must not drag in jax via the engine-tap modules.
+_LAZY = {
+    "EngineFrame": "engine", "RecordedRun": "engine",
+    "empty_frame": "engine", "frame_from_tap": "engine",
+    "recorded_ring_run": "engine",
+    "trace_ici_bytes": "ici",
+    "FlightRecorder": "recorder",
+    "NODE_COUNTERS": "registry", "NODE_HISTOGRAMS": "registry",
+    "Counter": "registry", "Histogram": "registry",
+    "MetricsRegistry": "registry",
+    "Span": "trace", "TraceSink": "trace", "NullSink": "trace",
+    "ListSink": "trace", "JsonlSink": "trace",
+    "render_prometheus": "expo", "render_health": "expo",
+    "HEALTH_RULES": "health", "Finding": "health",
+    "HealthMonitor": "health", "evaluate_registries": "health",
+}
+
+__all__ = sorted(_LAZY) + ["analyze", "health"]
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(f"{__name__}.{mod}"), name)
+    globals()[name] = value          # cache: resolve each name once
+    return value

@@ -4,16 +4,40 @@
 passes one pair per in-process node with `{"node": "<id>"}` — and
 renders every declared counter and histogram with HELP/TYPE metadata.
 Counters follow the `_total` suffix convention; histograms emit
-cumulative `_bucket{le=...}` series plus `_sum`/`_count`.
+cumulative `_bucket{le=...}` series plus `_sum`/`_count`.  Every render
+also emits one `swim_build_info` gauge (version + optional config
+labels) so scrapes are self-describing about what produced them.
+
+`render_health` renders obs/health.py findings as `swim_health_<rule>`
+gauges (1 = firing, 0 = quiet, every declared rule always present so
+the series never churn) plus an overall `swim_health_status` gauge
+(0 ok / 1 warn / 2 error) — appended to `/metrics` by the bridge
+server.  Label values are escaped per the text-format spec (backslash,
+double-quote, newline).
 """
 
 from __future__ import annotations
 
 from typing import Iterable
 
+from swim_tpu import __version__
+from swim_tpu.obs.health import HEALTH_RULES, Finding, severity_rank
 from swim_tpu.obs.registry import MetricsRegistry
 
 NAMESPACE = "swim"
+
+
+def _escape(value: object) -> str:
+    """Label-value escaping per text format 0.0.4: backslash first,
+    then double-quote and newline (raw interpolation previously
+    produced unparseable exposition for values containing any)."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and newline (not quotes)."""
+    return text.replace("\\", r"\\").replace("\n", r"\n")
 
 
 def _fmt_labels(labels: dict[str, str], extra: dict[str, str]
@@ -21,7 +45,7 @@ def _fmt_labels(labels: dict[str, str], extra: dict[str, str]
     merged = {**labels, **(extra or {})}
     if not merged:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in merged.items())
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in merged.items())
     return "{" + inner + "}"
 
 
@@ -29,11 +53,22 @@ def _fmt_float(v: float) -> str:
     return repr(float(v)) if v != int(v) else str(int(v))
 
 
+def render_build_info(build_labels: dict[str, str] | None = None,
+                      namespace: str = NAMESPACE) -> list[str]:
+    labels = {"version": __version__, **(build_labels or {})}
+    full = f"{namespace}_build_info"
+    return [f"# HELP {full} swim-tpu build/config info (value is "
+            "always 1; the labels carry the information)",
+            f"# TYPE {full} gauge",
+            f"{full}{_fmt_labels(labels)} 1"]
+
+
 def render_prometheus(registries: Iterable[tuple[dict[str, str],
                                                  MetricsRegistry]],
-                      namespace: str = NAMESPACE) -> str:
+                      namespace: str = NAMESPACE,
+                      build_labels: dict[str, str] | None = None) -> str:
     pairs = list(registries)
-    lines: list[str] = []
+    lines: list[str] = render_build_info(build_labels, namespace)
 
     counter_names: list[str] = []
     hist_names: list[str] = []
@@ -53,7 +88,7 @@ def render_prometheus(registries: Iterable[tuple[dict[str, str],
             if c is None:
                 continue
             if not helped:
-                lines.append(f"# HELP {full} {c.help}")
+                lines.append(f"# HELP {full} {_escape_help(c.help)}")
                 lines.append(f"# TYPE {full} counter")
                 helped = True
             lines.append(f"{full}{_fmt_labels(labels)} {c.value}")
@@ -66,7 +101,7 @@ def render_prometheus(registries: Iterable[tuple[dict[str, str],
             if h is None:
                 continue
             if not helped:
-                lines.append(f"# HELP {full} {h.help}")
+                lines.append(f"# HELP {full} {_escape_help(h.help)}")
                 lines.append(f"# TYPE {full} histogram")
                 helped = True
             cum = h.cumulative()
@@ -80,4 +115,31 @@ def render_prometheus(registries: Iterable[tuple[dict[str, str],
                          f"{_fmt_float(h.sum)}")
             lines.append(f"{full}_count{_fmt_labels(labels)} {h.count}")
 
+    return "\n".join(lines) + "\n"
+
+
+def render_health(findings: Iterable[Finding],
+                  labels: dict[str, str] | None = None,
+                  namespace: str = NAMESPACE) -> str:
+    """Current health as gauges.  EVERY rule in HEALTH_RULES renders
+    (0 when quiet) so the series set is stable across scrapes; firing
+    rules render 1.  `swim_health_status` carries the worst firing
+    severity as a number (0 ok / 1 warn / 2 error)."""
+    labels = labels or {}
+    firing = {f.rule: f for f in findings}
+    lines: list[str] = []
+    for rule, (severity, help_text) in HEALTH_RULES.items():
+        full = f"{namespace}_health_{rule}"
+        lines.append(f"# HELP {full} {_escape_help(help_text)} "
+                     f"(max severity: {severity})")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full}{_fmt_labels(labels)} "
+                     f"{1 if rule in firing else 0}")
+    status = max((severity_rank(f.severity) for f in firing.values()),
+                 default=0)
+    full = f"{namespace}_health_status"
+    lines.append(f"# HELP {full} Worst currently-firing health rule "
+                 "severity (0 ok / 1 warn / 2 error)")
+    lines.append(f"# TYPE {full} gauge")
+    lines.append(f"{full}{_fmt_labels(labels)} {status}")
     return "\n".join(lines) + "\n"

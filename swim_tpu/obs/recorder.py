@@ -4,13 +4,21 @@ Keeps the last K periods of `EngineFrame` counters in a host-side ring
 buffer and serializes them as JSONL on anomaly or on demand.  The dump
 is self-describing: line 1 is a header object (schema version, dump
 reason, frame field names, config snapshot, optional per-collective ICI
-byte tally from obs/ici.py), every following line is one period's frame.
+byte tally from obs/ici.py, optional embedded study milestones and
+health findings), every following line is one period's frame.
 
 `FlightRecorder.load` round-trips a dump back into a NamedTuple of
 arrays shaped like the engines' stacked frames, so
 `swim_tpu.utils.metrics.series_digest` works on re-read artifacts
 exactly as it does on live ones (tests/test_telemetry.py pins the
-round trip).
+round trip), and `swim_tpu.obs.analyze` recomputes the paper metrics
+from the dump alone.
+
+Health wiring: construct with `monitor=HealthMonitor(...)` and every
+recorded row streams through the rules engine; `auto_dump_reason()`
+surfaces any error-severity finding as a `"health:<rule>"` dump reason
+and `dump` embeds the findings in the header (previously only
+`false_dead_views > 0` could trigger an auto-dump).
 """
 
 from __future__ import annotations
@@ -24,61 +32,107 @@ from typing import Any
 import numpy as np
 
 from swim_tpu.obs.engine import EngineFrame
+from swim_tpu.obs.health import HealthMonitor
 
 KIND = "swim_tpu_flight_recorder"
 VERSION = 1
+
+# Host-side per-period counters the study runners produce NEXT TO the
+# engine tap (sim/runner.py PeriodSeries) that are worth recording in
+# the same row — accepted by `record`, round-tripped through dumps, and
+# visible to the health monitor's rules.
+AUX_FIELDS = ("false_dead_views",)
 
 
 class FlightRecorder:
     """Host-side ring buffer of the last `capacity` telemetry frames."""
 
     def __init__(self, cfg: Any = None, capacity: int = 64,
-                 ici_bytes: dict | None = None):
+                 ici_bytes: dict | None = None,
+                 monitor: HealthMonitor | None = None):
         if capacity < 1:
             raise ValueError("flight recorder needs capacity >= 1")
         self.capacity = capacity
         self.cfg = cfg
         self.ici_bytes = ici_bytes
+        self.monitor = monitor
         self._frames: collections.deque[dict] = collections.deque(
             maxlen=capacity)
+        self._aux_seen: set[str] = set()
 
     def __len__(self) -> int:
         return len(self._frames)
 
     def record(self, period: int, frame: Any) -> None:
         """Append one period.  `frame` is an EngineFrame of scalars or any
-        mapping/NamedTuple with (a subset of) its fields."""
+        mapping/NamedTuple with (a subset of) its fields, plus optional
+        AUX_FIELDS.  Missing fields zero-fill (documented: a partial tap
+        is a valid frame); an UNKNOWN key raises KeyError — the same
+        typo guard as the registry's undeclared-counter contract."""
         if hasattr(frame, "_asdict"):
             frame = frame._asdict()
+        unknown = set(frame) - set(EngineFrame._fields) - set(AUX_FIELDS)
+        if unknown:
+            raise KeyError(
+                f"unknown telemetry field(s) {sorted(unknown)} — frames "
+                "carry EngineFrame fields "
+                f"{list(EngineFrame._fields)} plus aux {list(AUX_FIELDS)} "
+                "(swim_tpu/obs/engine.py; a typo here would otherwise "
+                "silently record zeros)")
         row = {"period": int(period)}
         for name in EngineFrame._fields:
             row[name] = int(frame.get(name, 0))
+        for name in AUX_FIELDS:
+            if name in frame:
+                row[name] = int(frame[name])
+                self._aux_seen.add(name)
         self._frames.append(row)
+        if self.monitor is not None:
+            self.monitor.observe(int(period), row)
 
-    def record_stacked(self, frames: Any, start_period: int = 0) -> None:
+    def record_stacked(self, frames: Any, start_period: int = 0,
+                       aux: dict[str, Any] | None = None) -> None:
         """Feed a stacked EngineFrame (arrays of shape [T]) period by
-        period — the shape the engines' scans emit."""
+        period — the shape the engines' scans emit.  `aux` optionally
+        carries [T] arrays of AUX_FIELDS (e.g. the study runners'
+        false_dead_views series) merged into the same rows."""
         cols = {name: np.asarray(getattr(frames, name))
                 for name in EngineFrame._fields}
+        for name, arr in (aux or {}).items():
+            cols[name] = np.asarray(arr)
         t_len = len(next(iter(cols.values())))
         for t in range(t_len):
             self.record(start_period + t,
                         {name: cols[name][t] for name in cols})
 
-    def dump(self, path: str, reason: str = "on_demand") -> str:
-        """Write the buffer as JSONL (header line + one line/period)."""
-        header = {
+    def auto_dump_reason(self) -> str | None:
+        """`"health:<rule>"` when the attached monitor holds an
+        error-severity finding, else None."""
+        if self.monitor is None:
+            return None
+        return self.monitor.auto_dump_reason()
+
+    def dump(self, path: str, reason: str = "on_demand",
+             extra: dict | None = None) -> str:
+        """Write the buffer as JSONL (header line + one line/period).
+        `extra` merges additional self-describing sections into the
+        header (e.g. the detection study's milestone arrays); core keys
+        win on collision."""
+        header = dict(extra or {})
+        header.update({
             "kind": KIND,
             "version": VERSION,
             "reason": reason,
-            "fields": list(EngineFrame._fields),
+            "fields": list(EngineFrame._fields) + sorted(self._aux_seen),
             "capacity": self.capacity,
             "periods": len(self._frames),
-        }
+        })
         if self.cfg is not None:
             header["cfg"] = dataclasses.asdict(self.cfg)
         if self.ici_bytes is not None:
             header["ici_bytes"] = self.ici_bytes
+        if self.monitor is not None:
+            header["health"] = self.monitor.summary()
         with open(path, "w") as f:
             f.write(json.dumps(header) + "\n")
             for row in self._frames:

@@ -100,9 +100,14 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
 
     With `telemetry=True` (a SwimConfig knob riding in via cfg_kw) the
     result gains a `telemetry` digest of the per-period EngineFrame
-    series, and the flight recorder dumps the last periods to JSONL
-    when an anomaly fires (any false_dead_views > 0) or unconditionally
-    when `flight_record` names a path (the on-demand dump)."""
+    series plus a `health` summary from the sliding-window rules
+    engine (obs/health.py), and the flight recorder dumps the last
+    periods to JSONL when any error-severity finding fires (reason
+    `"health:<rule>"` — false_dead_views > 0 remains one such rule) or
+    unconditionally when `flight_record` names a path.  The dump
+    header embeds the crashed-subject detection milestones, so
+    `swim-tpu observe DUMP` reproduces this study's detection summary
+    offline (obs/analyze.py)."""
     engine = pick_engine(n, engine)
     if engine in ("ring", "ringshard"):
         # Fidelity by default (round 4; VERDICT r3 item 8): this study
@@ -132,16 +137,38 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
     if engine in ("rumor", "shard", "ring", "ringshard"):
         out["overflow"] = int(res.state.overflow)
     if res.telemetry is not None:
+        from swim_tpu.obs.health import HealthMonitor
         from swim_tpu.obs.recorder import FlightRecorder
 
         out["telemetry"] = metrics.series_digest(res.telemetry)
-        anomaly = int(np.asarray(
-            res.series.false_dead_views).max()) > 0
-        if flight_record or anomaly:
-            rec = FlightRecorder(cfg=cfg, capacity=min(64, periods))
-            rec.record_stacked(res.telemetry)
+        monitor = HealthMonitor(window=min(16, max(2, periods)),
+                                n_nodes=n)
+        rec = FlightRecorder(cfg=cfg, capacity=min(64, periods),
+                             monitor=monitor)
+        rec.record_stacked(res.telemetry, aux={
+            "false_dead_views": np.asarray(res.series.false_dead_views)})
+        out["health"] = {"worst": monitor.worst() or "ok",
+                         "findings": len(monitor.findings())}
+        reason = rec.auto_dump_reason()
+        if flight_record or reason:
+            crash, milestones = runner.study_milestones(res, plan,
+                                                        periods)
+            # effective probe regime for the law check: only ring
+            # engines can deviate (rotor, R1); dense/rumor probe
+            # uniformly, their cfg.ring_probe default is inert
+            study = {"n": n, "periods": periods, "engine": engine,
+                     "probe": (cfg.ring_probe
+                               if engine in ("ring", "ringshard")
+                               else "pull"),
+                     "crash_step": crash.tolist(),
+                     "false_dead_views_final": int(np.asarray(
+                         res.series.false_dead_views)[-1])}
+            for name, arr in milestones.items():
+                study[f"first_{name}" if name != "disseminated"
+                      else name] = arr.tolist()
             path = flight_record or "flight_record.jsonl"
-            rec.dump(path, reason="anomaly" if anomaly else "on_demand")
+            rec.dump(path, reason=reason or "on_demand",
+                     extra={"study": study})
             out["flight_record"] = path
     return out
 

@@ -339,26 +339,35 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
     return RingStudyResult(state, track, PeriodSeries(*series), frames)
 
 
-def detection_summary(result: StudyResult, plan: FaultPlan,
-                      periods: int) -> dict:
-    """Host-side digest: detection-latency distribution in periods."""
+def study_milestones(result: StudyResult, plan: FaultPlan,
+                     periods: int) -> tuple[np.ndarray, dict]:
+    """(crash steps, milestone arrays) restricted to CRASHED subjects —
+    the detection-summary inputs, in the shape the flight-recorder dump
+    header embeds (obs/analyze.py recomputes the summary from these
+    offline; milestone keys name the summary's output prefixes)."""
     crash = np.asarray(plan.crash_step)
     crashed = crash < periods
-    out = {"crashed": int(crashed.sum())}
-    if not crashed.any():
-        return out
-    for name, arr in (("suspect", result.track.first_suspect),
-                      ("dead_view", result.track.first_dead_view),
-                      ("disseminated", result.track.disseminated)):
-        arr = np.asarray(arr)
-        lat = arr[crashed].astype(np.int64) - crash[crashed]
-        ok = arr[crashed] != int(NEVER)
-        out[f"{name}_detected"] = int(ok.sum())
-        if ok.any():
-            lat_ok = lat[ok] + 1  # period t event ⇒ latency in (0, t+1]
-            out[f"{name}_latency_mean"] = float(lat_ok.mean())
-            out[f"{name}_latency_p50"] = float(np.percentile(lat_ok, 50))
-            out[f"{name}_latency_p99"] = float(np.percentile(lat_ok, 99))
-    out["false_dead_views_final"] = int(
-        np.asarray(result.series.false_dead_views)[-1])
-    return out
+    milestones = {
+        name: np.asarray(arr)[crashed].astype(np.int64)
+        for name, arr in (("suspect", result.track.first_suspect),
+                          ("dead_view", result.track.first_dead_view),
+                          ("disseminated", result.track.disseminated))}
+    return crash[crashed].astype(np.int64), milestones
+
+
+def detection_summary(result: StudyResult, plan: FaultPlan,
+                      periods: int) -> dict:
+    """Host-side digest: detection-latency distribution in periods.
+
+    Delegates the latency arithmetic to obs/analyze.py's
+    `summarize_detection` — the same function the offline analyzers
+    run over a recorder dump, so live and replayed summaries are
+    identical by construction."""
+    from swim_tpu.obs import analyze
+
+    crash, milestones = study_milestones(result, plan, periods)
+    if not crash.size:
+        return {"crashed": 0}
+    return analyze.summarize_detection(
+        crash, milestones,
+        int(np.asarray(result.series.false_dead_views)[-1]))

@@ -1,0 +1,415 @@
+"""Protocol observatory (PR 4): offline analyzers over telemetry
+artifacts (swim_tpu/obs/analyze.py), the sliding-window health rules
+engine (swim_tpu/obs/health.py), their wiring into the flight recorder
+and the bridge /metrics exposition, and the `swim-tpu observe` CLI.
+
+Load-bearing guarantees pinned here:
+
+  * a recorder dump is self-sufficient — `observe` reproduces the live
+    detection-study summary from the dump alone, numerically identical
+    (both sides delegate to analyze.summarize_detection);
+  * the measured mean first-detection latency sits on the SWIM paper's
+    e/(e−1) ≈ 1.582-period law (golden run, fixed seed);
+  * error-severity findings become `health:<rule>` auto-dump reasons
+    and `swim_health_*` gauges, and `observe --check` / run_suite gate
+    on them.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.obs import analyze
+from swim_tpu.obs.health import (HEALTH_RULES, Finding, HealthMonitor,
+                                 evaluate_registries, sort_findings)
+
+SMALL = dict(suspicion_mult=1.0, k_indirect=1, max_piggyback=2,
+             ring_window_periods=2, ring_view_c=2)
+# law-golden config: enough piggyback budget that the only findings are
+# the (correct) crash-burst warns, never an error — the artifact doubles
+# as the healthy case for the --check / run_suite gating tests
+LAW = dict(suspicion_mult=1.0, k_indirect=1, max_piggyback=8,
+           ring_window_periods=3, ring_view_c=2)
+
+
+@pytest.fixture(scope="module")
+def study_dump(tmp_path_factory):
+    """One telemetry-on detection study shared across the module:
+    (live summary dict, dump path)."""
+    from swim_tpu.sim import experiments
+
+    path = str(tmp_path_factory.mktemp("obs") / "fr.jsonl")
+    out = experiments.detection_study(n=128, periods=16, engine="ring",
+                                      telemetry=True, flight_record=path,
+                                      **SMALL)
+    return out, path
+
+
+@pytest.fixture(scope="module")
+def law_dump(tmp_path_factory):
+    """The e/(e−1) golden run: n=256, 27 crashes, pull probing."""
+    from swim_tpu.sim import experiments
+
+    path = str(tmp_path_factory.mktemp("law") / "fr.jsonl")
+    out = experiments.detection_study(n=256, periods=30, engine="ring",
+                                      crash_fraction=0.08, telemetry=True,
+                                      flight_record=path, **LAW)
+    return out, path
+
+
+# ---------------------------------------------------------------- monitor
+
+class TestHealthMonitor:
+    def test_false_dead_view_is_error(self):
+        m = HealthMonitor(window=4)
+        m.observe(0, {"false_dead_views": 0})
+        assert m.findings() == [] and m.worst() is None
+        m.observe(1, {"false_dead_views": 2})
+        (f,) = m.findings()
+        assert f.rule == "false_dead_views" and f.severity == "error"
+        assert f.period == 1 and f.value == 2
+        assert m.auto_dump_reason() == "health:false_dead_views"
+        g = m.gauges()
+        assert g["false_dead_views"] == 1.0 and g["status"] == 2.0
+
+    def test_overflow_growth_fires_on_window_delta(self):
+        m = HealthMonitor(window=4)
+        m.observe(0, {"overflow": 5})        # pre-existing level: quiet
+        assert m.findings() == []
+        m.observe(1, {"overflow": 5})
+        assert m.findings() == []
+        m.observe(2, {"overflow": 9})        # grew inside the window
+        (f,) = m.findings()
+        assert f.rule == "overflow_growth" and f.severity == "error"
+        assert f.value == 4
+
+    def test_stalled_dissemination_needs_full_quiet_window(self):
+        m = HealthMonitor(window=3)
+        for t in range(2):                   # window not full yet
+            m.observe(t, {"waves_delivered": 0, "win_occupancy": 7})
+        assert m.findings() == []
+        m.observe(2, {"waves_delivered": 0, "win_occupancy": 7})
+        (f,) = m.findings()
+        assert f.rule == "stalled_dissemination" and f.severity == "error"
+        # any delivery clears the active gauge (the finding is kept)
+        m.observe(3, {"waves_delivered": 5, "win_occupancy": 7})
+        assert m.gauges()["stalled_dissemination"] == 0.0
+        assert m.findings()[0].rule == "stalled_dissemination"
+
+    def test_probe_burst_spike_vs_baseline_escalation(self):
+        # steady failures (dead nodes being re-probed) must NOT fire …
+        m = HealthMonitor(window=8, n_nodes=100)
+        for t in range(8):
+            m.observe(t, {"probes_failed": 50})
+        assert m.findings() == []
+        # … a spike over the baseline fires; past max(64, 5%·n) = error
+        m2 = HealthMonitor(window=8, n_nodes=100)
+        for t in range(6):
+            m2.observe(t, {"probes_failed": 1})
+        m2.observe(6, {"probes_failed": 80})
+        (f,) = m2.findings()
+        assert f.rule == "probe_failure_burst" and f.severity == "error"
+        # small spike below the mass threshold stays a warn
+        m3 = HealthMonitor(window=8, n_nodes=10_000)
+        for t in range(6):
+            m3.observe(t, {"probes_failed": 1})
+        m3.observe(6, {"probes_failed": 30})
+        (f,) = m3.findings()
+        assert f.rule == "probe_failure_burst" and f.severity == "warn"
+
+    def test_saturation_spike_gauge_decays(self):
+        m = HealthMonitor(window=4)
+        for t in range(3):
+            m.observe(t, {"sel_rows_saturated": 0})
+        m.observe(3, {"sel_rows_saturated": 40})
+        (f,) = m.findings()
+        assert f.rule == "saturation_spike" and f.severity == "warn"
+        assert m.gauges()["saturation_spike"] == 1.0
+        for t in range(4, 8):                # spike slides out of window
+            m.observe(t, {"sel_rows_saturated": 40})
+        assert m.gauges()["saturation_spike"] == 0.0
+        assert m.worst() == "warn"           # history retained
+
+    def test_sorting_and_summary(self):
+        fs = [Finding("saturation_spike", "warn", 3, 9, 1, "w"),
+              Finding("false_dead_views", "error", 5, 1, 0, "e")]
+        assert [f.severity for f in sort_findings(fs)] == ["error", "warn"]
+        m = HealthMonitor(window=2)
+        m.observe(0, {"false_dead_views": 1})
+        s = m.summary()
+        assert s["worst"] == "error" and s["counts"] == {"error": 1}
+        assert s["findings"][0]["rule"] == "false_dead_views"
+
+    def test_finding_round_trip(self):
+        f = Finding("overflow_growth", "error", 7, 16.0, 0.0, "grew")
+        assert Finding.from_dict(json.loads(json.dumps(f.to_dict()))) == f
+
+    def test_rule_table_covers_monitor_rules(self):
+        m = HealthMonitor(window=2)
+        m.observe(0, {})
+        assert set(m.gauges()) == set(HEALTH_RULES) | {"status"}
+
+    def test_registry_rules(self):
+        from swim_tpu.obs.registry import MetricsRegistry
+
+        a, b = (MetricsRegistry.node_default() for _ in range(2))
+        a.counter("probes").inc(30)
+        a.counter("probe_failures").inc(20)
+        b.counter("decode_errors").inc(2)
+        fs = evaluate_registries([a, b])
+        assert [f.rule for f in fs] == ["node_decode_errors",
+                                       "node_probe_failure_rate"]
+        assert fs[0].severity == "error" and fs[1].severity == "warn"
+        assert evaluate_registries([MetricsRegistry.node_default()]) == []
+
+
+# ------------------------------------------------------- recorder wiring
+
+class TestRecorderHealthWiring:
+    def test_error_finding_becomes_auto_dump_reason(self, tmp_path):
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(cfg=SwimConfig(n_nodes=64, **SMALL),
+                             capacity=8, monitor=HealthMonitor(window=4))
+        rec.record(0, {"waves_delivered": 3, "false_dead_views": 0})
+        assert rec.auto_dump_reason() is None
+        rec.record(1, {"waves_delivered": 0, "false_dead_views": 2})
+        assert rec.auto_dump_reason() == "health:false_dead_views"
+        path = rec.dump(str(tmp_path / "f.jsonl"),
+                        reason=rec.auto_dump_reason())
+        header, frames = FlightRecorder.load(path)
+        assert header["reason"] == "health:false_dead_views"
+        restored = [Finding.from_dict(d)
+                    for d in header["health"]["findings"]]
+        assert restored[0].rule == "false_dead_views"
+        assert restored[0].severity == "error"
+        # aux column round-trips beside the EngineFrame fields
+        assert list(frames.false_dead_views) == [0, 2]
+
+    def test_monitorless_recorder_has_no_reason(self):
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(capacity=2)
+        rec.record(0, {"false_dead_views": 9})
+        assert rec.auto_dump_reason() is None
+
+
+# ------------------------------------------------------ offline analyzers
+
+class TestAnalyzeVsRunner:
+    def test_detection_summary_reproduced_from_dump_alone(self, study_dump):
+        """The acceptance bar: observe's offline replay == live study."""
+        out, path = study_dump
+        report = analyze.analyze(path)
+        assert report["kind"] == "flight_recorder"
+        det = report["detection"]
+        assert det["crashed"] == out["crashed"] > 0
+        for key, val in det.items():
+            assert val == out[key], key
+        assert report["health"]["worst"] == out["health"]["worst"]
+
+    def test_frame_sections_present_and_sane(self, study_dump):
+        out, path = study_dump
+        report = analyze.analyze(path)
+        assert report["periods"] == 16 and report["n_nodes"] == 128
+        dis = report["dissemination"]
+        assert dis["delivered_total"] > 0
+        assert 0 <= dis["periods_to_50pct"] <= dis["periods_to_90pct"] < 16
+        pig = report["piggyback"]
+        assert pig["budget"] == SMALL["max_piggyback"]
+        assert pig["slots_max_peak"] <= pig["budget"]
+        assert pig["saturation_trend"] in ("rising", "falling", "flat")
+        prb = report["probes"]
+        assert prb["failed_total"] > 0
+        assert prb["first_failure_period"] is not None
+        cdf = report["detection_cdf"]
+        assert cdf and cdf[-1][1] <= 1.0
+        assert all(f1 <= f2 for (_, f1), (_, f2) in zip(cdf, cdf[1:]))
+
+    def test_detection_law_golden(self, law_dump):
+        """SWIM paper §5: mean first-detection ≈ e/(e−1) periods under
+        uniform (pull) probing.  n=256, 21 crashed subjects under the
+        harness RNG (conftest sets jax_threefry_partitionable): measured
+        1.524 vs expected 1.580 (ratio 0.964, within sampling noise for
+        21 geometric draws)."""
+        out, path = law_dump
+        report = analyze.analyze(path)
+        law = report["detection_law"]
+        assert law["law_applies"] is True and law["probe"] == "pull"
+        assert law["e_over_e_minus_1"] == pytest.approx(1.58198, abs=1e-4)
+        # finite-N correction: p = 1 − (1 − 1/255)^255
+        assert law["expected_mean"] == pytest.approx(1.58017, abs=1e-4)
+        assert law["samples"] == out["crashed"] > 10
+        assert law["latency_mean"] == out["suspect_latency_mean"]
+        assert 1.2 < law["latency_mean"] < 2.1
+        assert 0.75 < law["mean_vs_law"] < 1.35
+        # the crash burst may (correctly) warn, but never error — this
+        # artifact is also the healthy case for the gating tests
+        assert report["health"]["worst"] in ("ok", "warn")
+        assert analyze.error_findings(report) == []
+
+    def test_rotor_probe_law_does_not_apply(self):
+        law = analyze.detection_law([2, 2], [3, 4], 256, probe="rotor")
+        assert law["law_applies"] is False and law["probe"] == "rotor"
+        assert law["latency_mean"] == pytest.approx(2.5)
+
+    def test_summarize_detection_edge_cases(self):
+        assert analyze.summarize_detection(np.array([], np.int64), {}) \
+            == {"crashed": 0}
+        det = analyze.summarize_detection(
+            np.array([2, 5]), {"suspect": np.array([3, analyze.NEVER])},
+            false_dead_final=1)
+        assert det["suspect_detected"] == 1
+        assert det["suspect_latency_mean"] == 2.0    # (3 − 2) + 1
+        assert det["false_dead_views_final"] == 1
+
+    def test_spans_analyzer(self, tmp_path):
+        from swim_tpu.core.cluster import SimCluster
+        from swim_tpu.obs.trace import JsonlSink
+
+        path = str(tmp_path / "spans.jsonl")
+        sink = JsonlSink(path)
+        c = SimCluster(SwimConfig(n_nodes=12, k_indirect=3,
+                                  protocol_period=1.0), seed=4, trace=sink)
+        c.start()
+        c.run(5.0)
+        c.kill(7)
+        c.run(20.0)
+        sink.close()
+        assert analyze.sniff(path) == "spans"
+        report = analyze.analyze(path)
+        assert report["kind"] == "trace_spans"
+        p = report["probes"]
+        assert p["outcomes"]["ack"] > 0 and p["outcomes"]["fail"] > 0
+        assert 0 < p["failure_rate"] < 1 and p["rtt_mean_s"] > 0
+        s = report["suspicions"]
+        assert s["outcomes"].get("confirmed", 0) > 0
+        assert 0 <= s["false_positive_rate"] <= 1
+
+    def test_sniff_rejects_foreign_jsonl(self, tmp_path):
+        p = tmp_path / "x.jsonl"
+        p.write_text('{"kind": "nope"}\n')
+        with pytest.raises(ValueError, match="neither"):
+            analyze.sniff(str(p))
+
+    def test_analyze_paths_merges_dump_and_spans(self, study_dump,
+                                                 tmp_path):
+        from swim_tpu.core.cluster import SimCluster
+        from swim_tpu.obs.trace import JsonlSink
+
+        _, dump_path = study_dump
+        spans_path = str(tmp_path / "spans.jsonl")
+        sink = JsonlSink(spans_path)
+        c = SimCluster(SwimConfig(n_nodes=6, protocol_period=1.0),
+                       seed=2, trace=sink)
+        c.start()
+        c.run(6.0)
+        sink.close()
+        merged = analyze.analyze_paths([dump_path, spans_path])
+        assert merged["engine"][dump_path]["kind"] == "flight_recorder"
+        assert merged["nodes"][spans_path]["kind"] == "trace_spans"
+        # error_findings walks merged reports too
+        assert analyze.error_findings(merged) == analyze.error_findings(
+            merged["engine"][dump_path])
+
+
+# ------------------------------------------------------------ observe CLI
+
+def _observe(*argv):
+    from swim_tpu.cli import main
+
+    return main(["observe", *argv])
+
+
+class TestObserveCLI:
+    def test_file_mode_renders_report(self, study_dump, capsys):
+        _, path = study_dump
+        assert _observe(path) == 0
+        out = capsys.readouterr().out
+        assert "flight recorder" in out and "detection" in out
+        assert "health:" in out
+
+    def test_json_mode_round_trips(self, study_dump, capsys):
+        out_live, path = study_dump
+        assert _observe(path, "--json") == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["detection"]["crashed"] == out_live["crashed"]
+
+    def test_check_gates_on_error_findings(self, law_dump, tmp_path,
+                                           capsys):
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        _, healthy = law_dump
+        assert _observe(healthy, "--check") == 0
+        rec = FlightRecorder(cfg=SwimConfig(n_nodes=64, **SMALL),
+                             capacity=4, monitor=HealthMonitor(window=2))
+        rec.record(0, {"false_dead_views": 3})
+        bad = rec.dump(str(tmp_path / "bad.jsonl"),
+                       reason=rec.auto_dump_reason())
+        assert _observe(bad, "--check") == 1
+        assert "false_dead_views" in capsys.readouterr().out
+
+    def test_follow_iterations_redraw(self, study_dump, capsys):
+        _, path = study_dump
+        assert _observe(path, "--follow", "--iterations", "2",
+                        "--interval", "0.01") == 0
+        out = capsys.readouterr().out
+        assert out.count("\x1b[2J") == 2
+
+    def test_missing_file_is_rc2(self, capsys):
+        assert _observe("/nonexistent/fr.jsonl") == 2
+        assert "error:" in capsys.readouterr().err
+
+    def test_url_mode_scrapes_health_gauges(self, capsys):
+        from swim_tpu.bridge import BridgeServer
+
+        server = BridgeServer(SwimConfig(n_nodes=4, protocol_period=1.0),
+                              n_internal=4, seed=6, metrics_port=0)
+        try:
+            server.start()
+            server.clock.advance(5.0)
+            host, port = server.metrics_address
+            url = f"http://{host}:{port}/metrics"
+            assert _observe(url, "--json") == 0
+            report = json.loads(capsys.readouterr().out)
+            assert report["kind"] == "metrics_scrape"
+            assert report["health"]["status"] == 0.0
+            assert set(HEALTH_RULES) <= set(report["health"])
+            assert report["counters"]["swim_probes_total"] > 0
+            assert 'version="' in report["build_info"]
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------- suite gating
+
+class TestSuiteGating:
+    def test_run_suite_analyze_artifacts(self, study_dump, tmp_path):
+        import importlib.util
+        import os
+        import shutil
+
+        spec = importlib.util.spec_from_file_location(
+            "run_suite", os.path.join(os.path.dirname(__file__), os.pardir,
+                                      "scripts", "run_suite.py"))
+        run_suite = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(run_suite)
+
+        _, dump_path = study_dump
+        art = tmp_path / "artifacts"
+        art.mkdir()
+        shutil.copy2(dump_path, art / "ok.jsonl")
+        assert run_suite.analyze_artifacts(str(art)) == []
+
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(cfg=SwimConfig(n_nodes=64, **SMALL),
+                             capacity=4, monitor=HealthMonitor(window=2))
+        rec.record(0, {"false_dead_views": 3})
+        rec.dump(str(art / "bad.jsonl"), reason=rec.auto_dump_reason())
+        errors = run_suite.analyze_artifacts(str(art))
+        assert len(errors) == 1 and "false_dead_views" in errors[0]

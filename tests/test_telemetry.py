@@ -139,10 +139,17 @@ class TestStudyPath:
         assert "telemetry" in out
         assert "waves_delivered_sum" in out["telemetry"]
         assert out["flight_record"] == path
+        assert out["health"]["worst"] in ("ok", "info", "warn", "error")
         header, frames = FlightRecorder.load(path)
-        assert header["reason"] in ("on_demand", "anomaly")
+        assert (header["reason"] in ("on_demand", "anomaly")
+                or header["reason"].startswith("health:"))
         assert header["periods"] == 16
         assert len(frames.period) == 16
+        # the dump is self-analyzing: crashed-subject milestones ride in
+        # the header's study section, health findings in header.health
+        assert header["study"]["n"] == 128
+        assert len(header["study"]["crash_step"]) == out["crashed"]
+        assert header["health"]["worst"] == out["health"]["worst"]
 
     def test_telemetry_off_is_default(self):
         from swim_tpu.sim import experiments
@@ -186,6 +193,19 @@ class TestFlightRecorder:
         assert header["ici_bytes"]["per_chip_bytes_per_period"] > 0
         assert header["ici_bytes"]["ici_ceiling_pps"] > 0
         assert "psum_scalar" in header["ici_bytes"]["breakdown"]
+
+    def test_record_unknown_key_raises(self):
+        """Typo guard: a misspelled frame field must fail loudly at the
+        record site (mirrors the registry's undeclared-counter KeyError),
+        not silently zero-fill a column nobody asked for."""
+        from swim_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(capacity=2)
+        with pytest.raises(KeyError, match="waves_deliverd"):
+            rec.record(0, {"waves_deliverd": 3})
+        rec.record(0, {"waves_delivered": 3,
+                       "false_dead_views": 0})      # aux field allowed
+        assert len(rec) == 1
 
     def test_load_rejects_foreign_jsonl(self, tmp_path):
         from swim_tpu.obs.recorder import FlightRecorder
@@ -236,6 +256,30 @@ class TestRegistryAndExposition:
         assert 'swim_probe_rtt_seconds_bucket{node="0",le="0.025"} 1' in text
         assert 'swim_probe_rtt_seconds_bucket{node="0",le="+Inf"} 1' in text
         assert 'swim_probe_rtt_seconds_count{node="0"} 1' in text
+
+    def test_label_value_escaping(self):
+        """Prometheus text format 0.0.4: backslash, double-quote, and
+        newline in label VALUES must be escaped — a node id like
+        `rack"7\\a` previously produced an unparseable exposition."""
+        from swim_tpu.obs.expo import render_prometheus
+        from swim_tpu.obs.registry import MetricsRegistry
+
+        reg = MetricsRegistry.node_default()
+        reg.counter("probes").inc()
+        text = render_prometheus([({"node": 'a\\b"c\nd'}, reg)])
+        assert 'node="a\\\\b\\"c\\nd"' in text
+        assert "\nswim_probes_total{node=\"a" in text  # one physical line
+
+    def test_build_info_gauge(self):
+        from swim_tpu import __version__
+        from swim_tpu.obs.expo import render_prometheus
+        from swim_tpu.obs.registry import MetricsRegistry
+
+        text = render_prometheus([({}, MetricsRegistry.node_default())],
+                                 build_labels={"nodes": "4"})
+        assert "# TYPE swim_build_info gauge" in text
+        assert (f'swim_build_info{{version="{__version__}",nodes="4"}} 1'
+                in text)
 
     def test_registry_lint_script(self):
         r = subprocess.run(
@@ -317,6 +361,11 @@ class TestBridgeMetricsEndpoint:
             assert "# TYPE swim_probes_total counter" in body
             assert 'swim_probes_total{node="0"}' in body
             assert 'swim_messages_out_total{node="3"}' in body
+            # health gauges + build info ride on the same exposition
+            assert 'swim_build_info{version=' in body
+            assert "# TYPE swim_health_status gauge" in body
+            assert "swim_health_status 0" in body       # healthy cluster
+            assert "swim_health_node_decode_errors 0" in body
             with pytest.raises(urllib.error.HTTPError):
                 urllib.request.urlopen(
                     f"http://{host}:{port}/nope", timeout=5)

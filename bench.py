@@ -369,11 +369,15 @@ def bench_rumor(n_nodes: int, periods: int, warmup: int = 2,
 
 def bench_ring(n_nodes: int, periods: int, warmup: int = 2,
                crash_fraction: float = 0.001,
-               ring_sel_scope: str = "wave") -> float:
+               ring_sel_scope: str = "wave",
+               ring_probe: str = "rotor") -> float:
     """Flagship tier: the scatter-free ring engine (models/ring.py) under
     the same detection workload — crash churn at simulator scale.  The
     'ringp' tier is this same harness with ring_sel_scope='period'
-    (deviation R5: one piggyback selection per period, not per wave)."""
+    (deviation R5: one piggyback selection per period, not per wave);
+    'ringpull' is the pull-mode probe (VERDICT r6 #5: the pull engine
+    was previously only ever measured through ad-hoc scripts, so its 1M
+    number could drift from the registered harness unnoticed)."""
     import jax
 
     from swim_tpu import SwimConfig
@@ -381,7 +385,8 @@ def bench_ring(n_nodes: int, periods: int, warmup: int = 2,
     from swim_tpu.parallel import mesh as pmesh
     from swim_tpu.sim import faults
 
-    cfg = SwimConfig(n_nodes=n_nodes, ring_sel_scope=ring_sel_scope)
+    cfg = SwimConfig(n_nodes=n_nodes, ring_sel_scope=ring_sel_scope,
+                     ring_probe=ring_probe)
     mesh = pmesh.make_mesh()
     # The initial state is all-zeros, so it is built INSIDE the jit
     # (a traced broadcast) instead of living on-device as a non-donated
@@ -522,10 +527,64 @@ def bench_telemetry_overhead(n_nodes: int, periods: int,
             "anchor_cfg": dict(LEAN_ANCHOR)}
 
 
+def bench_profiler_overhead(n_nodes: int, periods: int,
+                            warmup: int = 2, reps: int = 3) -> dict:
+    """Profiling-on vs profiling-off ring engine at the lean anchor.
+
+    Same contract form as bench_telemetry_overhead: the phase-marker
+    probes of obs/prof.py (`profiling=True`, marker mode) must cost
+    <= 5% of the headline metric.  The on-arm runs
+    obs.prof.profiled_ring_run, whose per-period marker vectors are
+    lax.scan outputs — XLA cannot dead-code-eliminate the folds, so the
+    measurement is honest.  (The prefix-differenced *timings* of
+    `swim-tpu profile` run extra programs and are inherently out of
+    band; this tier prices what stays resident in a production step.)
+    """
+    import jax
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import ring
+    from swim_tpu.obs.prof import profiled_ring_run
+    from swim_tpu.parallel import mesh as pmesh
+    from swim_tpu.sim import faults
+
+    cfg = SwimConfig(n_nodes=n_nodes, **LEAN_ANCHOR)
+    cfg_on = cfg.replace(profiling=True)
+    mesh = pmesh.make_mesh()
+    state = pmesh.shard_state(ring.init_state(cfg), mesh, n=n_nodes)
+    plan = faults.with_random_crashes(
+        faults.none(n_nodes), jax.random.key(1), 0.001, 0, max(periods, 1))
+    plan = pmesh.shard_state(plan, mesh, n=n_nodes)
+    key = jax.random.key(0)
+
+    def run_off(st, seed):
+        return ring.run(cfg, st, plan, jax.random.fold_in(key, seed),
+                        periods)
+
+    def run_on(st, seed):
+        return profiled_ring_run(cfg_on, st, plan,
+                                 jax.random.fold_in(key, seed), periods)
+
+    pps_off = max(_time_run(run_off, state, warmup if i == 0 else 0,
+                            periods) for i in range(max(reps, 1)))
+    pps_on = max(_time_run(run_on, state, warmup if i == 0 else 0,
+                           periods) for i in range(max(reps, 1)))
+    overhead = ((pps_off / pps_on - 1.0) * 100.0 if pps_on
+                else float("inf"))
+    return {"nodes": n_nodes, "periods": periods, "reps": reps,
+            "pps_off": round(pps_off, 2), "pps_on": round(pps_on, 2),
+            "overhead_pct": round(overhead, 2),
+            "contract_pct": 5.0,
+            "within_contract": overhead <= 5.0,
+            "anchor_cfg": dict(LEAN_ANCHOR)}
+
+
 TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
             "ringp": functools.partial(bench_ring,
                                        ring_sel_scope="period"),
+            "ringpull": functools.partial(bench_ring,
+                                          ring_probe="pull"),
             "ringshard": bench_ring_shard,
             "ringshardc": functools.partial(bench_ring_shard,
                                             ring_sel_scope="period",
@@ -537,6 +596,7 @@ TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
 RING_TIER_CFGS = {
     "ring": {},
     "ringp": {"ring_sel_scope": "period"},
+    "ringpull": {"ring_probe": "pull"},
     "ringshard": {},
     "ringshardc": {"ring_sel_scope": "period", "ring_ici_wire": "compact"},
 }
@@ -552,26 +612,31 @@ def run_tier_child(args) -> int:
 
         jax.config.update("jax_platforms", args.platform)
     # else ("default"/"auto"): leave the ambient platform alone.
-    if args._tier == "telemetry":
+    if args._tier in ("telemetry", "profiler"):
+        # Contract tiers share one shape: measure an on/off overhead at
+        # the lean anchor, pin the <=5% contract, persist the artifact.
+        fn = (bench_telemetry_overhead if args._tier == "telemetry"
+              else bench_profiler_overhead)
+        artifact = f"{args._tier}_overhead.json"
         try:
             import jax
 
-            res = bench_telemetry_overhead(args.nodes, args.periods)
-            res.update(ok=True, tier="telemetry",
+            res = fn(args.nodes, args.periods)
+            res.update(ok=True, tier=args._tier,
                        platform_actual=jax.devices()[0].platform)
             path = os.path.join(
                 os.path.dirname(os.path.abspath(__file__)),
-                "bench_results", "telemetry_overhead.json")
+                "bench_results", artifact)
             os.makedirs(os.path.dirname(path), exist_ok=True)
             res["captured_at"] = time.strftime(
                 "%Y-%m-%d %H:%M:%S UTC", time.gmtime())
             res["commit"] = _git_commit()
             with open(path, "w") as f:
                 json.dump(res, f, indent=1)
-            res["artifact"] = "bench_results/telemetry_overhead.json"
+            res["artifact"] = f"bench_results/{artifact}"
             print(json.dumps(res))
         except Exception as e:  # noqa: BLE001 — containment
-            print(json.dumps({"ok": False, "tier": "telemetry",
+            print(json.dumps({"ok": False, "tier": args._tier,
                               "nodes": args.nodes,
                               "error": f"{type(e).__name__}: {e}"[:500]}))
         return 0
@@ -666,7 +731,8 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--tier", default="flagship",
                     choices=("dense", "rumor", "shard", "ring", "ringp",
-                             "ringshard", "ringshardc", "telemetry",
+                             "ringpull", "ringshard", "ringshardc",
+                             "telemetry", "profiler",
                              "flagship", "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
@@ -726,8 +792,8 @@ def main() -> int:
     tiers = {"flagship": ["ring", "ringp", "ringshard"],
              "both": ["dense", "ring"],
              "all": ["dense", "rumor", "shard", "ring", "ringp",
-                     "ringshard", "ringshardc"]}.get(args.tier,
-                                                     [args.tier])
+                     "ringpull", "ringshard",
+                     "ringshardc"]}.get(args.tier, [args.tier])
     results = {}
     backend_dead = False
     for tier in tiers:
@@ -763,19 +829,19 @@ def main() -> int:
                 backend_dead = True
                 info["backend_died_after"] = tier
 
-    if args.tier == "telemetry":
-        # Contract tier, not a throughput tier: the headline value is the
+    if args.tier in ("telemetry", "profiler"):
+        # Contract tiers, not throughput tiers: the headline value is the
         # measured on/off overhead percentage (<= 5.0 keeps the contract).
-        r = results.get("telemetry", {})
+        r = results.get(args.tier, {})
         if r.get("ok"):
-            out = {"metric": (f"telemetry overhead pct @ {r['nodes']} "
+            out = {"metric": (f"{args.tier} overhead pct @ {r['nodes']} "
                               f"nodes (ring engine, lean anchor, "
                               f"{platform})"),
                    "value": r["overhead_pct"], "unit": "percent",
                    "platform": platform}
             out.update({k: v for k, v in r.items() if k != "ok"})
         else:
-            out = {"metric": ("telemetry overhead pct (tier failed, "
+            out = {"metric": (f"{args.tier} overhead pct (tier failed, "
                               f"{platform})"),
                    "value": -1.0, "unit": "percent",
                    "platform": platform, "error": r.get("error")}
@@ -788,8 +854,8 @@ def main() -> int:
     # scalable tier succeeded — its small-N exact-engine pps is not
     # comparable to the 1M-node target.
     head_tier, head = None, None
-    for tier in ("ring", "ringp", "ringshard", "ringshardc", "shard",
-                 "rumor"):
+    for tier in ("ring", "ringp", "ringpull", "ringshard", "ringshardc",
+                 "shard", "rumor"):
         r = results.get(tier)
         if r and r.get("ok"):
             if head is None or r["periods_per_sec"] > head["periods_per_sec"]:

@@ -15,6 +15,12 @@ name suffix with a known severity, and `render_health` must emit exactly
 {swim_health_<rule>} ∪ {swim_health_status} — so the gauge names on the
 bridge's /metrics never drift from the rule table docs/dashboards key on.
 
+And the profiler-gauge surface: every `swim_prof_*` string literal in
+obs/expo.py `render_profile` must be declared in obs/prof.py
+PROF_GAUGES and vice versa (AST source scan, mirroring the stats-key
+lint — render_profile's own runtime assert only fires when a profile
+artifact actually renders, which CI without an artifact never does).
+
 Run directly (`python scripts/check_metrics_registry.py`) or via the
 fast tier-1 test that shells out to it (tests/test_telemetry.py).
 """
@@ -83,6 +89,47 @@ def check_health_gauges() -> list[str]:
     return problems
 
 
+def check_prof_gauges() -> list[str]:
+    """Problems with the swim_prof_* gauge surface ([] = clean).
+
+    Source-level cross-check: the `swim_prof_*` names render_profile
+    writes (string literals in obs/expo.py) must be exactly
+    prof.PROF_GAUGES, and each must be a legal Prometheus metric name.
+    """
+    import re
+
+    from swim_tpu.obs.prof import PROF_GAUGES
+
+    expo_py = os.path.join(os.path.dirname(NODE_PY), os.pardir,
+                           "obs", "expo.py")
+    with open(expo_py) as f:
+        tree = ast.parse(f.read(), filename=expo_py)
+    emitted: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for m in re.findall(r"swim_prof_[a-z0-9_]+", node.value):
+                emitted.add(m)
+        elif isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.Constant) \
+                        and isinstance(part.value, str):
+                    for m in re.findall(r"swim_prof_[a-z0-9_]+",
+                                        part.value):
+                        emitted.add(m)
+    problems: list[str] = []
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for name in PROF_GAUGES:
+        if not name_re.match(name):
+            problems.append(f"PROF_GAUGES entry {name!r} is not a legal "
+                            "Prometheus metric name")
+    if emitted != set(PROF_GAUGES):
+        problems.append(
+            f"obs/expo.py mentions {sorted(emitted)} but prof.PROF_GAUGES "
+            f"declares {sorted(PROF_GAUGES)} — keep render_profile and "
+            "the phase table in lockstep")
+    return problems
+
+
 def main() -> int:
     from swim_tpu.obs.registry import NODE_COUNTERS
 
@@ -108,11 +155,17 @@ def main() -> int:
     for problem in health_problems:
         ok = False
         print(f"health-gauge lint: {problem}", file=sys.stderr)
+    prof_problems = check_prof_gauges()
+    for problem in prof_problems:
+        ok = False
+        print(f"prof-gauge lint: {problem}", file=sys.stderr)
     from swim_tpu.obs.health import HEALTH_RULES
+    from swim_tpu.obs.prof import PROF_GAUGES
 
     print(f"checked {len(keys)} stats keys against "
-          f"{len(NODE_COUNTERS)} declared counters and "
-          f"{len(HEALTH_RULES)} health gauges: "
+          f"{len(NODE_COUNTERS)} declared counters, "
+          f"{len(HEALTH_RULES)} health gauges and "
+          f"{len(PROF_GAUGES)} profiler gauges: "
           f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
 

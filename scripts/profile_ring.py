@@ -6,17 +6,16 @@ Usage: python scripts/profile_ring.py [N] [--periods P] [--trace DIR]
 
 Times a jitted multi-period run, then (with --trace) writes a
 jax.profiler trace and prints the top-K XLA ops by self time parsed
-straight out of the .trace.json.gz — no TensorBoard needed.
+straight out of the .trace.json.gz — no TensorBoard needed (the parser
+lives in swim_tpu.obs.prof.top_ops_from_trace, shared with `swim-tpu
+profile`, which adds phase-level attribution on top of this script's
+whole-step view).
 """
 from __future__ import annotations
 
-import glob
-import gzip
-import json
 import os
 import sys
 import time
-from collections import defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -81,39 +80,17 @@ with jax.profiler.trace(trace_dir):
     jax.block_until_ready(run(state))
 
 # ---- parse the trace: top ops by device self-time -------------------------
-paths = sorted(glob.glob(os.path.join(
-    trace_dir, "**", "*.trace.json.gz"), recursive=True), key=os.path.getmtime)
-if not paths:
-    sys.exit(f"no trace.json.gz under {trace_dir}")
-with gzip.open(paths[-1], "rt") as f:
-    tr = json.load(f)
+from swim_tpu.obs.prof import top_ops_from_trace
 
-# device lanes only (TPU/xla ops live on pids whose process name mentions
-# the device); fall back to every complete event if the filter comes up dry
-proc_name: dict[int, str] = {}
-for ev in tr.get("traceEvents", []):
-    if ev.get("ph") == "M" and ev.get("name") == "process_name":
-        proc_name[ev["pid"]] = ev.get("args", {}).get("name", "")
+try:
+    top = top_ops_from_trace(trace_dir, top_k=top_k)
+except FileNotFoundError as e:
+    sys.exit(str(e))
 
-by_op: dict[str, float] = defaultdict(float)
-count: dict[str, int] = defaultdict(int)
-total = 0.0
-for ev in tr.get("traceEvents", []):
-    if ev.get("ph") != "X":
-        continue
-    pname = proc_name.get(ev.get("pid"), "")
-    if ("TPU" not in pname and "/device" not in pname
-            and "Chip" not in pname and "XLA" not in pname):
-        continue
-    dur = float(ev.get("dur", 0.0))
-    name = ev.get("name", "?")
-    by_op[name] += dur
-    count[name] += 1
-    total += dur
-
-print(f"\ntrace: {paths[-1]}")
-print(f"device events total: {total / 1e6:.3f}s "
+print(f"\ntrace: {top['trace']}")
+print(f"device events total: {top['total_us'] / 1e6:.3f}s "
       f"(over {periods} profiled periods)")
-print(f"{'self us':>12} {'calls':>7}  op")
-for name, us in sorted(by_op.items(), key=lambda kv: -kv[1])[:top_k]:
-    print(f"{us:12.0f} {count[name]:7d}  {name[:110]}")
+print(f"{'self us':>12} {'calls':>7}  {'phase':<12} op")
+for op in top["ops"]:
+    print(f"{op['self_us']:12.0f} {op['calls']:7d}  "
+          f"{(op['phase_guess'] or '-'):<12} {op['op'][:96]}")

@@ -13,15 +13,22 @@ mesh; this script only scrubs PALLAS_AXON_POOL_IPS so a dead axon TPU
 tunnel cannot hang interpreter startup (sitecustomize dials it when the
 var is set).
 
-Usage: python scripts/run_suite.py [--timeout-per-file S]
+Usage: python scripts/run_suite.py [--timeout-per-file S] [--fast]
          [--artifacts-dir DIR] [pattern]
 Exit 0 iff every file's pytest exited 0.  `--artifacts-dir DIR` copies
 the run's telemetry/bench artifacts (bench_results/*.json, any
 *flight_record*.jsonl the tests left behind) into DIR afterwards,
-prints the inventory, and runs the obs analyzers (swim_tpu/obs/analyze)
+prints the inventory, runs the obs analyzers (swim_tpu/obs/analyze)
 over every captured .jsonl — an error-severity health finding in any
 artifact fails the run, so CI gates on protocol health, not just on
-assertions.
+assertions — and finally runs the bench trend gate (swim_tpu/obs/trend
+--check): a >10% periods/sec drop vs the last-good bench round in the
+captured artifacts also fails the run.
+
+`--fast` swaps the default pattern for FAST_FILES, a curated
+sub-5-minute smoke tier (host-side protocol logic, harness registries,
+roofline math, observability, bridge conformance, profiler contracts)
+for pre-push iteration; the full per-file suite stays the CI tier.
 """
 from __future__ import annotations
 
@@ -36,6 +43,23 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# --fast tier: one representative file per subsystem, chosen for wall
+# time (no multi-engine equivalence sweeps, no 64k-node compiles) while
+# still crossing every layer — host protocol units, bench harness
+# registries, roofline model math, obs analyzers/health/trend, the
+# profiler contracts, and the bridge conformance server.  Budget: the
+# whole tier (one pytest process per file) must stay under 5 minutes.
+FAST_FILES = (
+    "tests/test_core_units.py",
+    "tests/test_bench_harness.py",
+    "tests/test_roofline.py",
+    "tests/test_observatory.py",
+    "tests/test_profiler.py",
+    "tests/test_bridge.py",
+    "tests/test_graft_entry.py",
+    "tests/test_sampling.py",
+)
 
 
 def analyze_artifacts(dest: str) -> list[str]:
@@ -89,9 +113,16 @@ def main() -> int:
     ap.add_argument("--artifacts-dir", default=None,
                     help="copy bench_results JSON + telemetry JSONL "
                          "artifacts here after the run")
+    ap.add_argument("--fast", action="store_true",
+                    help="run the curated <5-minute smoke tier "
+                         "(FAST_FILES) instead of the full suite")
     args = ap.parse_args()
 
-    files = sorted(glob.glob(os.path.join(REPO, args.pattern)))
+    if args.fast and args.pattern == "tests/test_*.py":
+        files = [os.path.join(REPO, rel) for rel in FAST_FILES
+                 if os.path.exists(os.path.join(REPO, rel))]
+    else:
+        files = sorted(glob.glob(os.path.join(REPO, args.pattern)))
     if not files:
         print(f"no test files match {args.pattern}", file=sys.stderr)
         return 2
@@ -165,6 +196,22 @@ def main() -> int:
                   "artifact(s):", file=sys.stderr)
             for line in errors:
                 print(f"  {line}", file=sys.stderr)
+            return 1
+        # Bench trend gate (jax-free): a >10% periods/sec drop vs the
+        # last-good bench round is a regression the assertions can't
+        # see — fail the gated run, same as an error-severity finding.
+        from swim_tpu.obs import trend
+
+        checks = trend.check(trend.series(trend.collect(REPO)))
+        for c in checks:
+            print(f"  trend [{'ok' if c['ok'] else 'FAIL'}] "
+                  f"{c['tier']}@{c['nodes']}/{c['platform']}: "
+                  f"r{c['latest_round']} {c['latest_pps']} vs last-good "
+                  f"r{c['last_good_round']} {c['last_good_pps']} "
+                  f"(drop {c['drop_pct']}%)", flush=True)
+        if any(not c["ok"] for c in checks):
+            print("bench trend gate FAILED (>10% drop vs last-good)",
+                  file=sys.stderr)
             return 1
     return 1 if failures else 0
 

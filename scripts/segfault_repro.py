@@ -1,0 +1,74 @@
+"""Minimal standalone repro for the jaxlib-CPU many-compiles segfault.
+
+Why this file exists (VERDICT r6 #8): a single pytest process running
+all of tests/ segfaults inside a pjit dispatch around test ~145 — after
+hundreds of distinct compiled executables have accumulated in one
+interpreter — while every test file passes in isolation.  That crash is
+the entire reason scripts/run_suite.py runs one pytest process per
+file.  This script is the smallest self-contained program that walks
+the same cliff, so the failure can be demonstrated, bisected against
+jaxlib versions, and reported upstream without dragging the test suite
+along.
+
+Mechanism: compile and dispatch MANY DISTINCT jitted programs (each
+iteration pads a different static shape, so nothing is served from
+cache) in one process.  Each program is trivial; the crash is a
+function of accumulated executables, not of any one program's size.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/segfault_repro.py [N] [--verbose]
+
+N defaults to 600 distinct compiles (comfortably past the observed
+~145-test cliff; each test file compiles several programs).  Exit 0
+with "survived" means this jaxlib build took N compiles without
+crashing — raise N before concluding the bug is gone.  A segfault
+(rc -11 from the shell) is the repro.  Progress prints every 25
+compiles so the crash point is attributable.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def distinct_program(i: int):
+    """Return a freshly-jitted program no previous iteration compiled.
+
+    The static pad width makes every signature unique, so XLA compiles
+    and retains a new executable each call — the accumulation pattern
+    that precedes the crash.  The body mixes the ops the suite's
+    engines lean on (reduction, gather, where) to stay representative.
+    """
+    pad = i % 97 + 1
+
+    @jax.jit
+    def prog(x):
+        y = jnp.pad(x, (0, pad))
+        idx = jnp.argsort(y)[: x.shape[0]]
+        return jnp.where(y[idx] > 0, y[idx], -y[idx]).sum()
+
+    return prog
+
+
+def main() -> int:
+    n = 600
+    verbose = "--verbose" in sys.argv[1:]
+    for a in sys.argv[1:]:
+        if a.isdigit():
+            n = int(a)
+    print(f"jax {jax.__version__} on {jax.devices()[0].platform}; "
+          f"compiling {n} distinct programs in one process", flush=True)
+    x = jnp.arange(1024, dtype=jnp.float32)
+    for i in range(n):
+        out = float(distinct_program(i)(x))
+        if verbose or i % 25 == 0:
+            print(f"  compile {i:4d} ok (out={out:.0f})", flush=True)
+    print(f"survived {n} distinct compiles — no segfault on this build",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,6 +190,20 @@ CAPTURES: list = [
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",
       "--trace", "/tmp/tr_r3"], 1800, False, None),
+    # Phase-level attribution (obs/prof.py): prefix-differenced phase
+    # timings + roofline byte accounting at 1M; --out auto persists
+    # bench_results/profile_phases.json for the bridge's swim_prof_*
+    # gauges, --trace attaches the top-op table for RESULTS.md §10.
+    ("profile_phases_1m",
+     ["-m", "swim_tpu.cli", "profile", "--nodes", "1000000",
+      "--trace", "/tmp/tr_phases", "--json", "--out", "auto"], 1800,
+     False, None),
+    # Profiler overhead contract on the real chip (the committed
+    # artifact is the 65k lean-anchor CPU measurement; this records the
+    # accelerator's number alongside it).
+    ("profiler_overhead_1m",
+     ["bench.py", "--tier", "profiler", "--nodes", "1000000",
+      "--periods", "20"], 1800, False, None),
     # Real λ sweep (BASELINE config 4): 5 multipliers × 2 loss rates = 10
     # full 1M-node 100-period runs — budget accordingly.
     ("study_suspicion_1m",
@@ -203,6 +217,31 @@ CAPTURES: list = [
       "--engine", "ring", "--periods", "100", "--budget-arms"], 7200,
      True, None),
 ]
+
+
+def _write_trend() -> None:
+    """Refresh bench_results/trend.json after a capture pass.
+
+    Best-effort and jax-free (swim_tpu.obs.trend reads JSON only): the
+    summary folds the fresh captures into the per-tier periods/sec
+    trajectories and runs the regression gate, so the watcher's output
+    directory always carries an up-to-date trend verdict next to the
+    raw capture records.  A broken artifact must not kill the watch
+    loop, hence the broad containment.
+    """
+    try:
+        from swim_tpu.obs import trend
+
+        summary = trend.summarize(REPO)
+        os.makedirs(OUT, exist_ok=True)
+        tmp = os.path.join(OUT, "trend.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(summary, f, indent=1)
+        os.replace(tmp, os.path.join(OUT, "trend.json"))
+        gate = "PASS" if summary.get("ok", True) else "FAIL"
+        print(f"[tpu_watch] trend refreshed (gate: {gate})", flush=True)
+    except Exception as e:  # noqa: BLE001 — watcher must outlive this
+        print(f"[tpu_watch] trend refresh failed: {e}", flush=True)
 
 
 def main() -> int:
@@ -237,6 +276,7 @@ def main() -> int:
                     # (CPU-fallback payload = tunnel flap) stays un-done
                     # and retries at the next recovery.
                     done.add(name)
+            _write_trend()
             if {c[0] for c in CAPTURES if c[3]} <= done:
                 print("[tpu_watch] capture complete", flush=True)
                 return 0

@@ -28,13 +28,18 @@ from swim_tpu.core.transport import Address, InProcessTransport, SimNetwork
 
 def _make_metrics_server(host: str, port: int, nodes: list[Node]):
     """Stdlib HTTP server exposing GET /metrics (Prometheus text 0.0.4):
-    per-node typed registries, a `swim_build_info` gauge, and the
-    current `swim_health_*` gauges (obs/health.py real-node rules
-    evaluated per scrape — `swim-tpu observe URL --follow` tails this)."""
+    per-node typed registries, a `swim_build_info` gauge, the current
+    `swim_health_*` gauges (obs/health.py real-node rules evaluated per
+    scrape — `swim-tpu observe URL --follow` tails this), and — when a
+    profile artifact exists (bench_results/profile_phases.json, written
+    by `swim-tpu profile --out`) — the latest `swim_prof_*`
+    phase-attribution gauges (obs/prof.py)."""
     import http.server
 
-    from swim_tpu.obs.expo import render_health, render_prometheus
+    from swim_tpu.obs.expo import (render_health, render_profile,
+                                   render_prometheus)
     from swim_tpu.obs.health import evaluate_registries
+    from swim_tpu.obs.prof import load_artifact
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):                                  # noqa: N802
@@ -46,6 +51,9 @@ def _make_metrics_server(host: str, port: int, nodes: list[Node]):
                 build_labels={"nodes": str(len(nodes))})
             body += render_health(
                 evaluate_registries(n.registry for n in nodes))
+            profile = load_artifact()      # best-effort; None when absent
+            if profile is not None:
+                body += render_profile(profile)
             data = body.encode()
             self.send_response(200)
             self.send_header("Content-Type",

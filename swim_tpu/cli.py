@@ -10,6 +10,12 @@ Subcommands:
   observe   — analyze telemetry artifacts offline (flight-recorder
               dumps, trace-span JSONL) or tail a live dump / a
               /metrics URL as a refreshing terminal view
+  profile   — phase-level step attribution (obs/prof.py): per-phase
+              device-synced timings, modeled vs achieved HBM/ICI
+              bytes, floor-or-fixable verdicts, optional device-trace
+              top-op table
+  trend     — jax-free per-tier bench trajectories over BENCH_r*.json
+              + bench_results/, with a --check regression gate
 """
 
 from __future__ import annotations
@@ -355,6 +361,52 @@ def _cmd_observe(args: argparse.Namespace) -> int:
             return 0
 
 
+def _cmd_profile(args: argparse.Namespace) -> int:
+    from swim_tpu import SwimConfig
+    from swim_tpu.obs import prof as prof_mod
+
+    # defaults are the 65k lean anchor (bench.py LEAN_ANCHOR): the
+    # geometry every overhead/coverage contract is quoted at
+    cfg = SwimConfig(
+        n_nodes=args.nodes, ring_probe=args.probe,
+        ring_sel_scope=args.sel_scope,
+        suspicion_mult=args.suspicion_mult,
+        retransmit_mult=args.retransmit_mult,
+        k_indirect=args.k_indirect,
+        ring_window_periods=args.window_periods,
+        ring_view_c=args.view_c)
+    report = prof_mod.profile_ring(
+        cfg, settle=args.settle, reps=args.reps, seed=args.seed,
+        crash_fraction=args.crash_fraction,
+        trace_dir=args.trace or None, top_k=args.top)
+    if args.out:
+        path = prof_mod.save_artifact(
+            report, None if args.out == "auto" else args.out)
+        print(f"# wrote {path}", file=sys.stderr)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(prof_mod.render_report(report))
+    if args.check and report["coverage_pct"] < report.get(
+            "contract_coverage_pct", 95.0):
+        return 1
+    return 0
+
+
+def _cmd_trend(args: argparse.Namespace) -> int:
+    from swim_tpu.obs import trend
+
+    argv = []
+    if args.repo:
+        argv += ["--repo", args.repo]
+    argv += ["--threshold", str(args.threshold)]
+    if args.json:
+        argv.append("--json")
+    if args.check:
+        argv.append("--check")
+    return trend.main(argv)
+
+
 def _cmd_bridge(args: argparse.Namespace) -> int:
     from swim_tpu import SwimConfig
     from swim_tpu.bridge import BridgeServer
@@ -490,6 +542,55 @@ def build_parser() -> argparse.ArgumentParser:
                     help="exit 1 if any error-severity health finding "
                          "(CI gate)")
     ob.set_defaults(fn=_cmd_observe)
+
+    pr = sub.add_parser(
+        "profile", help="phase-level step attribution with roofline "
+                        "byte accounting (obs/prof.py)")
+    pr.add_argument("--nodes", type=int, default=65536)
+    pr.add_argument("--settle", type=int, default=2,
+                    help="periods to run before timing (steady state)")
+    pr.add_argument("--reps", type=int, default=5,
+                    help="timed dispatches per program (best-of)")
+    pr.add_argument("--seed", type=int, default=0)
+    pr.add_argument("--crash-fraction", type=float, default=0.001)
+    pr.add_argument("--probe", choices=("rotor", "pull"), default="rotor")
+    pr.add_argument("--sel-scope", choices=("wave", "period"),
+                    default="period",
+                    help="default 'period' — the lean-anchor/throughput "
+                         "mode whose fused path exposes all six phases")
+    pr.add_argument("--suspicion-mult", type=float, default=2.0)
+    pr.add_argument("--retransmit-mult", type=float, default=2.0)
+    pr.add_argument("--k-indirect", type=int, default=1)
+    pr.add_argument("--window-periods", type=int, default=3)
+    pr.add_argument("--view-c", type=int, default=2)
+    pr.add_argument("--trace", default="",
+                    help="also capture a jax.profiler device trace to "
+                         "this dir and attach the top-op table")
+    pr.add_argument("--top", type=int, default=5,
+                    help="top-K ops from the device trace")
+    pr.add_argument("--json", action="store_true",
+                    help="emit the raw report as JSON")
+    pr.add_argument("--out", default="",
+                    help="write the report artifact ('auto' = "
+                         "bench_results/profile_phases.json, the file "
+                         "the bridge's swim_prof_* gauges serve)")
+    pr.add_argument("--check", action="store_true",
+                    help="exit 1 if attribution coverage misses the "
+                         "≥95%% contract")
+    pr.set_defaults(fn=_cmd_profile)
+
+    tr = sub.add_parser(
+        "trend", help="per-tier bench p/s trajectories + regression "
+                      "gate (jax-free; obs/trend.py)")
+    tr.add_argument("--repo", default=None,
+                    help="repo root holding BENCH_r*.json + "
+                         "bench_results/ (default: auto-detect)")
+    tr.add_argument("--threshold", type=float, default=0.10)
+    tr.add_argument("--json", action="store_true")
+    tr.add_argument("--check", action="store_true",
+                    help="exit 1 when any tier regresses >threshold "
+                         "vs its last-good round")
+    tr.set_defaults(fn=_cmd_trend)
 
     br = sub.add_parser(
         "bridge", help="serve a simulated cluster for an external core "

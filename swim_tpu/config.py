@@ -130,6 +130,19 @@ class SwimConfig:
     #                              (tests/test_ring_shard.py pins it) and
     #                              the measured overhead contract lives in
     #                              bench.py --telemetry-overhead.
+    profiling: bool = False      # per-period phase markers (obs/prof.py
+    #                              PhaseProbe): one cheap replicated i32
+    #                              signature per named step phase,
+    #                              collected inside the scan so the
+    #                              profiled program's phase structure is
+    #                              live (not dead-code-eliminated).  Off
+    #                              by default; the probe is additive —
+    #                              protocol state is bitwise identical
+    #                              either way (tests/test_profiler.py +
+    #                              the tri-run in tests/test_ring_shard.py
+    #                              pin it) and the measured overhead
+    #                              contract lives in bench.py --tier
+    #                              profiler.
     ring_ici_wire: str = "window"  # sharded wave-exchange payload
     #                              (parallel/ring_shard.py; inert in the
     #                              single-program engine, which has no

@@ -104,7 +104,8 @@ def _apply_forced(cfg: SwimConfig, sel_idx, sel_valid, forced):
 
 
 def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
-         rnd: PeriodRandomness, tap: dict | None = None) -> DenseState:
+         rnd: PeriodRandomness, tap: dict | None = None,
+         prof=None) -> DenseState:
     """One protocol period for all N nodes (pure; jit with cfg static).
 
     `tap` (optional, static presence) receives per-period telemetry
@@ -112,6 +113,12 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     feeds back into state; with tap=None the traced program is
     unchanged, so telemetry-on state is bitwise identical to
     telemetry-off.
+
+    `prof` (optional, static presence) is an obs/prof.py PhaseProbe.
+    The dense engine reports the coarse phase subset (select / merge /
+    commit / telemetry_tap): its per-wave piggyback selection and
+    delivery interleave, so the wave chain is one "merge" phase.  Like
+    tap, prof=None leaves the traced program unchanged.
     """
     n, k = cfg.n_nodes, cfg.k_indirect
     t = state.step
@@ -156,6 +163,10 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     proxies = jnp.argmax(cum2[:, None, :] > idx2[:, :, None],
                          axis=-1).astype(jnp.int32)    # i32[N, k]
     has_proxy = c2 > 0
+
+    if prof is not None and prof.cut("select", target, target=target,
+                                     proxies=proxies, prober=prober):
+        return prof.captured
 
     def buddy(cur_key, src, dst):
         """forced subject per message: dst if src believes dst SUSPECT.
@@ -226,6 +237,12 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     key, retransmit, deadline = carry
     relayed = jnp.any(w6_ok.reshape(n, k), axis=-1)
 
+    if prof is not None and prof.cut("merge", key, key=key,
+                                     retransmit=retransmit,
+                                     deadline=deadline, acked=acked,
+                                     relayed=relayed):
+        return prof.captured
+
     # ---- End of period (docs/PROTOCOL.md §3) ------------------------------
 
     # 1. probe verdicts (health read at probe time, updated after)
@@ -278,6 +295,11 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     deadline = jnp.where(frozen, state.deadline, deadline)
     lha = jnp.where(~up, state.lha, lha)
 
+    if prof is not None and prof.cut("commit", key, key=key,
+                                     retransmit=retransmit,
+                                     deadline=deadline, lha=lha):
+        return prof.captured
+
     if tap is not None:
         # ---- telemetry tap (swim_tpu/obs/engine.py EngineFrame) ----------
         # Selection stats measure the start-of-period piggyback pass;
@@ -296,6 +318,8 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
             + jnp.sum(w4_ok) + jnp.sum(w5_ok)
             + jnp.sum(w6_ok)).astype(jnp.int32)
         tap["probes_failed"] = jnp.sum(failed).astype(jnp.int32)
+        if prof is not None:
+            prof.cut("telemetry_tap", tap["sel_slots_selected"])
 
     return DenseState(key, retransmit, deadline, lha, t + 1)
 

@@ -719,7 +719,7 @@ class GlobalOps:
 def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
          rnd: RingRandomness, ops: GlobalOps | None = None,
          ext: ExtOriginations | None = None,
-         tap: dict | None = None) -> RingState:
+         tap: dict | None = None, prof=None) -> RingState:
     """One protocol period for all N nodes (pure; jit with cfg static).
 
     With the default `ops`, every array spans the full node axis; under
@@ -736,6 +736,16 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     The tap never feeds back into state; with tap=None the traced
     program is unchanged — telemetry-on protocol state is bitwise
     identical to telemetry-off by construction.
+
+    `prof` (optional, static presence) is a swim_tpu/obs/prof.py
+    PhaseProbe marking the step's phase boundaries (select / pack /
+    ppermute / merge / commit / telemetry_tap).  In marker mode each
+    cut folds one already-live array into a replicated i32 signature;
+    in prefix mode the step RETURNS EARLY at the probe's named boundary
+    with the phase's live arrays (`prof.captured`) so the profiler can
+    difference device-synced prefix timings.  Like tap/ext, prof=None
+    leaves the traced program unchanged — the profiling-on bitwise
+    parity is structural.
     """
     if ops is None:
         ops = GlobalOps(cfg)
@@ -970,6 +980,21 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     lha = state.lha
     delivered_ct = jnp.int32(0)        # telemetry: gossip waves delivered
 
+    if prof is not None:
+        # end of "select": window shifted, top-C index built, first-B
+        # selection done (period scope).  Probe = win: already consumed
+        # by every wave, so the marker adds no fusion-breaking reads
+        # (sel_base must stay single-consumer — see the tap note below).
+        sel_parts = dict(win=win, elig_mask=elig_mask, gone_key=gone_key,
+                         overflow=overflow, index_overflow=index_overflow,
+                         sus_slot=sus_slot, sus_bk=sus_bk,
+                         top_key=jnp.stack(top_key),
+                         top_slot=jnp.stack(top_slot))
+        if period_scope:
+            sel_parts["sel_base"] = sel_base
+        if prof.cut("select", win, ops=ops, **sel_parts):
+            return prof.captured
+
     if cfg.ring_probe == "rotor":
         # Rotor: target(i) = i + s_t; every wave is a roll (deviation R1).
         s_off = rnd.s_off
@@ -1071,6 +1096,15 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             deliver(ok6, q)
             relayed = relayed | (ok6 & need)
 
+        if fused and prof is not None:
+            # end of "ppermute": the full ok chain (per-wave delivery
+            # flags and their node-vector rolls) is decided; nothing has
+            # touched the window yet.  NOTE the fused path stages its
+            # payloads AFTER the ok chain, so the cut order here is
+            # ppermute -> pack (obs/prof.py phases_for documents it).
+            oks_now = jnp.stack([w[0] for w in waves])
+            if prof.cut("ppermute", oks_now, ops=ops, win=win):
+                return prof.captured
         if fused:
             # Buddy forced bits ride as receiver-aligned compact rows:
             # roll the sender-side (col, val) by the wave's offset and
@@ -1084,10 +1118,30 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                 bcols.append(roll_from(col, d))
                 bvals.append(jnp.where(ok, roll_from(val, d),
                                        jnp.uint32(0)))
+            if prof is not None:
+                # end of "pack": wave payload staging (buddy compact
+                # rows rolled+masked; the sharded compact wire's B-slot
+                # packing rides inside merge_waves and lands in
+                # "merge" here)
+                pk_parts = dict(win=win,
+                                oks=jnp.stack([w[0] for w in waves]))
+                if bvals:
+                    pk_parts["bcol"] = jnp.stack(bcols)
+                    pk_parts["bval"] = jnp.stack(bvals)
+                if prof.cut("pack", pk_parts.get("bval", win), ops=ops,
+                            **pk_parts):
+                    return prof.captured
             win = ops.merge_waves(
                 win, sel_base, [w[0] for w in waves],
                 [w[1] for w in waves], bcols, bvals,
                 impl=cfg.ring_wave_kernel)
+
+        if prof is not None and prof.cut("merge", win, ops=ops, win=win,
+                                         acked=acked, relayed=relayed):
+            # end of "merge": every wave's selection is OR-delivered
+            # into the window (one fused merge_waves pass, or the
+            # in-line per-wave ORs on the wave-scope path)
+            return prof.captured
 
         probe_ok = acked | relayed
         failed = prober & ~probe_ok
@@ -1255,6 +1309,12 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         win = win | jnp.where(ack_gossip_ok[:, None],
                               ops.gather_rows(sel_all, aq),
                               jnp.uint32(0))
+        if prof is not None and prof.cut(
+                "merge", win, ops=ops, win=win, acked=acked_lane,
+                relayed=relayed_lane):
+            # end of "merge" (pull): direct + proxy + ack-pull gossip
+            # all gathered and OR-delivered
+            return prof.captured
         failed = probe_live & ~(acked_lane | relayed_lane)
         if tap is not None:
             delivered_ct = (jnp.sum(d_fwd_ok) + jnp.sum(px_deliver)
@@ -1543,6 +1603,15 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     inc_self = jnp.where(active, inc_self, state.inc_self)
     lha = jnp.where(active, lha, state.lha)
 
+    if prof is not None and prof.cut(
+            "commit", subject, ops=ops, win=win, cold=cold,
+            inc_self=inc_self, lha=lha, gone_key=gone_key, rkey=rkey,
+            birth0=birth0, snode=snode, stime=stime, confirmed=confirmed,
+            overflow=overflow, index_overflow=index_overflow):
+        # end of "commit": verdicts, query pass, Phase C+D, full state
+        # assembled — this prefix is the whole step minus the tap
+        return prof.captured
+
     if tap is not None:
         # ---- telemetry tap (swim_tpu/obs/engine.py EngineFrame) ----------
         # Every value is reduced through the ops seam, so single-program
@@ -1567,6 +1636,9 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         tap["probes_failed"] = ops.gsum(jnp.sum(failed).astype(jnp.int32))
         tap["overflow"] = overflow
         tap["index_overflow"] = index_overflow
+        if prof is not None:
+            # tap values are already replicated reductions — no ops
+            prof.cut("telemetry_tap", tap["sel_slots_selected"])
 
     return RingState(
         win=win, cold=cold, inc_self=inc_self, lha=lha, gone_key=gone_key,

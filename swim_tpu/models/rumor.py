@@ -209,7 +209,8 @@ def view_matrix(cfg: SwimConfig, state: RumorState) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
-         rnd: RumorRandomness, tap: dict | None = None) -> RumorState:
+         rnd: RumorRandomness, tap: dict | None = None,
+         prof=None) -> RumorState:
     """One protocol period for all N nodes (pure; jit with cfg static).
 
     `tap` (optional, static presence) receives per-period telemetry
@@ -217,6 +218,12 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     feeds back into state; with tap=None the traced program is
     unchanged, so telemetry-on state is bitwise identical to
     telemetry-off.
+
+    `prof` (optional, static presence) is an obs/prof.py PhaseProbe.
+    Like the dense engine, the rumor engine reports the coarse phase
+    subset (select / merge / commit / telemetry_tap): per-wave
+    selection and delivery interleave inside `wave`.  prof=None leaves
+    the traced program unchanged.
     """
     n, k, r_cap = cfg.n_nodes, cfg.k_indirect, cfg.rumor_slots
     s_cap = cfg.sentinels
@@ -319,6 +326,12 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     cand_idx = cand_idx.astype(jnp.int32)
     cand_valid = eligible[cand_idx]                          # bool[W]
 
+    if prof is not None and prof.cut(
+            "select", target, target=target, prox=prox, prober=prober,
+            cand_idx=cand_idx, cand_valid=cand_valid, subject=subject,
+            gone_key=gone_key):
+        return prof.captured
+
     knows = st.knows
 
     def select_first_b(kn):
@@ -420,6 +433,10 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
                         no_force_k)
     relayed = jnp.any(w6_ok.reshape(n, k), axis=-1)
     st = st._replace(knows=knows)
+
+    if prof is not None and prof.cut("merge", knows, knows=knows,
+                                     acked=acked, relayed=relayed):
+        return prof.captured
 
     # ---- Phase C: end-of-period verdicts (docs/PROTOCOL.md §3) ------------
 
@@ -577,6 +594,13 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     inc_self = jnp.where(~up, state.inc_self, inc_self)
     lha = jnp.where(~up, state.lha, lha)
 
+    if prof is not None and prof.cut(
+            "commit", rkey, knows=knows, inc_self=inc_self, lha=lha,
+            gone_key=gone_key, subject=subject, rkey=rkey, birth=birth,
+            snode=snode, stime=stime, confirmed=confirmed,
+            overflow=overflow):
+        return prof.captured
+
     if tap is not None:
         # ---- telemetry tap (swim_tpu/obs/engine.py EngineFrame) ----------
         # Selection stats measure the start-of-period piggyback pass (the
@@ -597,6 +621,8 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
             + jnp.sum(w6_ok)).astype(jnp.int32)
         tap["probes_failed"] = jnp.sum(failed).astype(jnp.int32)
         tap["overflow"] = overflow
+        if prof is not None:
+            prof.cut("telemetry_tap", tap["sel_slots_selected"])
 
     return RumorState(
         knows=knows, inc_self=inc_self, lha=lha, gone_key=gone_key,

@@ -20,6 +20,16 @@ Two stacks, one subsystem:
   and `render_prometheus` is the text exposition served by the bridge
   server's `/metrics` endpoint.
 
+* **Performance observatory** — `prof` segments each engine step into
+  named phases (select / pack / ppermute / merge / commit /
+  telemetry_tap) with device-synced prefix-differenced timings and
+  modeled-vs-achieved HBM/ICI bytes per phase (roofline ceilings shared
+  with utils/roofline.py and obs/ici.py); `trend` is the jax-free bench
+  trajectory engine + `--check` regression gate over `bench_results/`
+  and `BENCH_r*.json`.  `swim-tpu profile` / `swim-tpu trend` are the
+  CLI faces; `render_profile` exposes the latest profile artifact as
+  `swim_prof_*` gauges on the bridge `/metrics`.
+
 * **Analysis & health** — `analyze` computes the paper's protocol
   metrics offline from recorded artifacts (detection-latency CDF vs
   the e/(e−1) law, infection-curve progress, piggyback pressure, span
@@ -44,6 +54,12 @@ _LAZY = {
     "recorded_ring_run": "engine",
     "trace_ici_bytes": "ici",
     "FlightRecorder": "recorder",
+    # prof is import-time jax-free (jax deferred to call time); the
+    # PhaseProbe/profile_ring entry points do run jax when called
+    "PHASES": "prof", "PROF_GAUGES": "prof", "PhaseProbe": "prof",
+    "ProfiledRun": "prof", "profiled_ring_run": "prof",
+    "phases_for": "prof", "profile_ring": "prof",
+    "render_profile": "expo",
     "NODE_COUNTERS": "registry", "NODE_HISTOGRAMS": "registry",
     "Counter": "registry", "Histogram": "registry",
     "MetricsRegistry": "registry",
@@ -54,7 +70,7 @@ _LAZY = {
     "HealthMonitor": "health", "evaluate_registries": "health",
 }
 
-__all__ = sorted(_LAZY) + ["analyze", "health"]
+__all__ = sorted(_LAZY) + ["analyze", "health", "trend"]
 
 
 def __getattr__(name: str):

@@ -143,3 +143,78 @@ def render_health(findings: Iterable[Finding],
     lines.append(f"# TYPE {full} gauge")
     lines.append(f"{full}{_fmt_labels(labels)} {status}")
     return "\n".join(lines) + "\n"
+
+
+def render_profile(report: dict,
+                   labels: dict[str, str] | None = None) -> str:
+    """The latest obs/prof.py phase-attribution report as swim_prof_*
+    gauges (names pinned in prof.PROF_GAUGES and linted against this
+    renderer by scripts/check_metrics_registry.py).  Per-phase series
+    carry a `phase` label; modeled HBM bytes carry `bracket`
+    (fused/unfused roofline model).  Reports are point-in-time
+    artifacts, so every series also carries the capture's nodes and
+    platform as labels — a 65k CPU profile and a 1M TPU profile never
+    alias."""
+    # import-time jax-free: prof.py defers jax to call time
+    from swim_tpu.obs.prof import PROF_GAUGES
+
+    base = {**(labels or {}),
+            "nodes": str(report.get("nodes", "?")),
+            "platform": str(report.get("platform_actual", "?"))}
+    help_txt = {
+        "swim_prof_phase_ms": "Measured per-phase step time "
+        "(prefix-differenced, device-synced), ms",
+        "swim_prof_phase_fraction": "Phase share of the measured step "
+        "wall time",
+        "swim_prof_phase_model_bytes": "Modeled HBM bytes per phase "
+        "(utils/roofline.py terms; bracket=fused/unfused)",
+        "swim_prof_phase_xla_bytes": "Achieved bytes per phase (XLA "
+        "cost-analysis prefix delta)",
+        "swim_prof_phase_ici_bytes": "Modeled per-chip ICI bytes per "
+        "phase (obs/ici.py collective tally)",
+        "swim_prof_step_ms": "Measured full step time, ms",
+        "swim_prof_coverage_pct": "Phase attribution coverage of the "
+        "measured step wall time, percent",
+    }
+    lines: list[str] = []
+
+    def _head(full: str) -> None:
+        lines.append(f"# HELP {full} {_escape_help(help_txt[full])}")
+        lines.append(f"# TYPE {full} gauge")
+
+    rows = report.get("phases", [])
+    for name, field in (("swim_prof_phase_ms", "ms"),
+                        ("swim_prof_phase_fraction", "fraction")):
+        _head(name)
+        for row in rows:
+            lines.append(f"{name}"
+                         f"{_fmt_labels(base, {'phase': row['phase']})} "
+                         f"{_fmt_float(row[field])}")
+    _head("swim_prof_phase_model_bytes")
+    for row in rows:
+        for bracket in ("fused", "unfused"):
+            lines.append(
+                "swim_prof_phase_model_bytes"
+                f"{_fmt_labels(base, {'phase': row['phase'], 'bracket': bracket})}"
+                f" {row[f'hbm_model_{bracket}_bytes']}")
+    _head("swim_prof_phase_xla_bytes")
+    for row in rows:
+        if row.get("xla_bytes") is not None:
+            lines.append(
+                "swim_prof_phase_xla_bytes"
+                f"{_fmt_labels(base, {'phase': row['phase']})} "
+                f"{row['xla_bytes']}")
+    _head("swim_prof_phase_ici_bytes")
+    for row in rows:
+        lines.append(
+            "swim_prof_phase_ici_bytes"
+            f"{_fmt_labels(base, {'phase': row['phase']})} "
+            f"{row['ici_model_bytes']}")
+    _head("swim_prof_step_ms")
+    lines.append(f"swim_prof_step_ms{_fmt_labels(base)} "
+                 f"{_fmt_float(report.get('step_ms', 0.0))}")
+    _head("swim_prof_coverage_pct")
+    lines.append(f"swim_prof_coverage_pct{_fmt_labels(base)} "
+                 f"{_fmt_float(report.get('coverage_pct', 0.0))}")
+    assert set(help_txt) == set(PROF_GAUGES)
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,611 @@
+"""Phase-level step profiler: where does the protocol period's time go?
+
+The engines' `step` functions accept an optional `prof` PhaseProbe.  The
+probe marks the boundaries between the step's named phases:
+
+  select         window maintenance (Phase 0a-0d bookkeeping), the
+                 per-subject top-C index, and the first-B piggyback
+                 selection — everything up to the selection the waves
+                 will carry
+  pack           staging the wave payloads (buddy forced-bit compact
+                 rows; on the sharded compact wire this is where the
+                 B-slot-index packing cost lives)
+  ppermute       the wave ok-chain: per-wave delivery flags and their
+                 node-vector rolls (the sharded twin's ppermute traffic)
+  merge          the delivery ORs into the window (ops.merge_waves on
+                 the fused path; in-line per-wave ORs otherwise)
+  commit         probe verdicts, the fused view/self query pass,
+                 Phase C refutation + sentinel expiry, Phase D
+                 originations, state assembly
+  telemetry_tap  the EngineFrame tap reductions (cfg.telemetry)
+
+Two probe modes, both static at trace time (prof=None leaves the traced
+program unchanged — the profiling-on/off bitwise-parity pin is
+structural, exactly like the telemetry tap):
+
+* **marker mode** (`until=None`): each `cut()` folds a tiny slice of the
+  phase's live arrays into one replicated i32 signature through the
+  `ops` seam and the step returns normally.  `profiled_ring_run` stacks
+  the per-period marker vectors as scan outputs, so the probe's cost is
+  real (not dead-code-eliminated) and the ≤5% overhead contract is
+  measurable (bench.py --tier profiler).
+* **prefix mode** (`until=<phase>`): the step returns early at the named
+  boundary with the phase's live arrays.  `profile_ring` jits one
+  program per boundary and DIFFERENCES their device-synced timings:
+  phase time = t(prefix_i) − t(prefix_{i−1}).  The deltas telescope to
+  the full step's time, which is what makes the ≥95% attribution-
+  coverage contract honest rather than lucky; XLA dead-code-eliminates
+  later-phase work from each prefix, so a delta is the marginal cost of
+  exactly the work the phase makes live.
+
+Per phase the report pairs the measured time with **modeled vs achieved
+bytes**: the analytic HBM model is utils/roofline.py's per-term traffic
+accounting mapped term→phase; the achieved bytes are XLA's own
+cost-analysis estimate differenced across the same prefixes; the ICI
+model is obs/ici.py's per-collective tally mapped collective→phase.
+Roofline ceilings (V5E_HBM_GBPS / V5E_ICI_GBPS) are shared with
+utils/roofline.py and obs/ici.py — the same constants test_roofline.py
+pins.
+
+The floor-or-fixable verdict per phase: "floor" means the phase already
+moves about as many bytes as the algorithm requires (achieved ≤
+FIXABLE_RATIO × the unfused model bracket) and, when measured on real
+hardware, streams them at a credible fraction of HBM bandwidth — only an
+algorithmic byte cut (bit-packing, fewer passes) can speed it up.
+"fixable" means the gap to the model is engineering headroom: fusion,
+layout copies, or launch overhead.
+
+`swim-tpu profile` is the CLI face; `render_profile` (obs/expo.py)
+exposes the latest report as `swim_prof_*` gauges on the bridge
+/metrics endpoint; docs/OBSERVABILITY.md documents the contracts.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Any, NamedTuple
+
+# Canonical phase order (the attribution table renders in this order; a
+# config whose step cannot separate the fine wave phases reports the
+# coarse subset from phases_for()).
+PHASES = ("select", "pack", "ppermute", "merge", "commit",
+          "telemetry_tap")
+
+# utils/roofline.py ring_traffic term -> phase (the HBM byte model).
+HBM_TERM_PHASE = {
+    "phase0_shift_flush": "select",
+    "topc_index": "select",
+    "waves": "merge",
+    "wave_vectors": "ppermute",
+    "buddy_bits": "pack",
+    "query_pass": "commit",
+    "phase_cd": "commit",
+}
+
+# Prometheus gauge names emitted by obs/expo.py render_profile — kept in
+# lockstep by scripts/check_metrics_registry.py (AST lint, no imports).
+PROF_GAUGES = (
+    "swim_prof_phase_ms",
+    "swim_prof_phase_fraction",
+    "swim_prof_phase_model_bytes",
+    "swim_prof_phase_xla_bytes",
+    "swim_prof_phase_ici_bytes",
+    "swim_prof_step_ms",
+    "swim_prof_coverage_pct",
+)
+
+# achieved-bytes-to-model threshold for the floor verdict: the unfused
+# bracket already charges every named intermediate a full HBM
+# round-trip, so a phase above 1.25x that bracket is moving bytes the
+# algorithm never asked for (layout copies, broken fusion) — fixable.
+FIXABLE_RATIO = 1.25
+# on real hardware a byte-floor phase must also stream at a credible
+# fraction of HBM bandwidth, or the time (not the bytes) is the defect
+FLOOR_MIN_BW_FRAC = 0.5
+
+_FOLD_ELEMS = 256       # marker fold width: tiny, deterministic, cheap
+
+
+def _fold(a):
+    """Cheap deterministic i32 signature of one array's leading slice."""
+    import jax.numpy as jnp
+
+    x = a.reshape(-1)[:_FOLD_ELEMS]
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.int32)
+    elif jnp.issubdtype(x.dtype, jnp.unsignedinteger):
+        x = (x & jnp.asarray(0x7FFF, x.dtype)).astype(jnp.int32)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        x = (x != 0).astype(jnp.int32)
+    else:
+        x = x.astype(jnp.int32)
+    return jnp.sum(x)
+
+
+class PhaseProbe:
+    """The phase-boundary seam threaded through the engines' step.
+
+    Constructed fresh per trace.  `cut(name, ops=..., **parts)` returns
+    True when the step should return early (`prefix mode` reached its
+    boundary); the caller then returns `probe.captured`.  In marker mode
+    it records one replicated i32 signature per phase and always returns
+    False.
+    """
+
+    __slots__ = ("until", "markers", "captured")
+
+    def __init__(self, until: str | None = None):
+        if until is not None and until not in PHASES:
+            raise ValueError(f"unknown phase {until!r}; know {PHASES}")
+        self.until = until
+        self.markers: dict[str, Any] = {}
+        self.captured: Any = None
+
+    def cut(self, name: str, probe, ops=None, **parts) -> bool:
+        """Mark the end of phase `name`.
+
+        `probe` is the ONE array the marker folds — the caller picks an
+        array the phase already materializes for later consumers, so
+        marker mode adds no new fusion-breaking reads (the tap's
+        sel_base lesson: a second consumer of the selection broke the
+        fused wave merge for +10%/period).  `parts` are captured only
+        in prefix mode: they define the live set whose computation the
+        prefix program must keep (everything else is dead code to XLA,
+        which is exactly what makes the timing delta the phase's
+        marginal cost).
+        """
+        import jax.numpy as jnp
+
+        m = _fold(probe)
+        if ops is not None:
+            m = ops.gsum(m.astype(jnp.int32))
+        self.markers[name] = m
+        if self.until == name:
+            parts["_probe"] = probe
+            self.captured = parts
+            return True
+        return False
+
+    def marker_vector(self):
+        """i32[len(PHASES)] in canonical order; 0 for phases not cut."""
+        import jax.numpy as jnp
+
+        return jnp.stack([jnp.asarray(self.markers.get(p, 0), jnp.int32)
+                          for p in PHASES])
+
+
+class ProfiledRun(NamedTuple):
+    """Final state + stacked i32[T, len(PHASES)] phase markers.
+
+    `.step` proxies the state's period counter so bench.py's `_time_run`
+    execution proof applies unchanged to the profiling-on arm.
+    """
+
+    state: Any
+    markers: Any
+
+    @property
+    def step(self):
+        return self.state.step
+
+
+@functools.lru_cache(maxsize=8)
+def _profiled_run_fn(cfg, periods: int):
+    import jax
+
+    from swim_tpu.models import ring
+
+    def run(state, plan, root_key):
+        def body(st, _):
+            pr = PhaseProbe()
+            st = ring.step(cfg, st, plan,
+                           ring.draw_period_ring(root_key, st.step, cfg),
+                           prof=pr)
+            return st, pr.marker_vector()
+
+        state, markers = jax.lax.scan(body, state, None, length=periods)
+        return ProfiledRun(state, markers)
+
+    return jax.jit(run)
+
+
+def profiled_ring_run(cfg, state, plan, root_key, periods: int):
+    """ring.run with the phase probe in marker mode: one fused scan,
+    marker vectors as ys — the profiling-on arm of the overhead
+    contract (markers are scan OUTPUTS, so the probe cost is real)."""
+    return _profiled_run_fn(cfg, int(periods))(state, plan, root_key)
+
+
+def phases_for(cfg) -> tuple[str, ...]:
+    """The phases a config's step can separate, in CUT order.
+
+    The fused period-scope rotor path (the flagship) exposes all six —
+    but it stages wave payloads AFTER deciding the ok chain, so its cut
+    order is select -> ppermute -> pack -> merge (prefix differencing
+    must follow the code's boundary order to telescope).  Wave-scope
+    rotor delivers in-line per wave (selection and merge interleave)
+    and pull mode delivers by gather, so both report the coarse subset
+    with the wave work under "merge"."""
+    fused = (cfg.ring_probe == "rotor"
+             and cfg.ring_sel_scope == "period"
+             and (2 + 4 * cfg.k_indirect) <= 32)
+    if fused:
+        return ("select", "ppermute", "pack", "merge", "commit",
+                "telemetry_tap")
+    return ("select", "merge", "commit", "telemetry_tap")
+
+
+def phase_hbm_model(cfg) -> dict[str, tuple[float, float]]:
+    """(fused, unfused) modeled HBM bytes per phase, from the roofline
+    per-term accounting (utils/roofline.py ring_traffic)."""
+    from swim_tpu.utils import roofline as rl
+
+    active = phases_for(cfg)
+    out: dict[str, list[float]] = {p: [0.0, 0.0] for p in active}
+    for term, (f, u) in rl.ring_traffic(cfg)["terms"].items():
+        p = HBM_TERM_PHASE[term]
+        if p not in out:       # coarse phase set: wave terms fold into merge
+            p = "merge" if p in ("pack", "ppermute") else p
+        out[p][0] += f
+        out[p][1] += u
+    return {p: (f, u) for p, (f, u) in out.items()}
+
+
+def phase_ici_model(cfg, d: int = 8) -> dict[str, int]:
+    """Modeled per-chip ICI bytes per phase for a `d`-chip sharding,
+    from obs/ici.py's per-collective tally (collective -> phase)."""
+    from swim_tpu.obs.ici import trace_ici_bytes
+
+    active = phases_for(cfg)
+    out = {p: 0 for p in active}
+    for key, nbytes in trace_ici_bytes(cfg, d)["breakdown"].items():
+        if key == "sel_wire_boundary" or key.startswith("roll_sel_waves"):
+            p = "merge"
+        elif key.startswith("roll["):
+            p = "ppermute" if "ppermute" in active else "merge"
+        else:   # psum_scalar / gather_psum / knows_psum / candidates_*
+            p = "commit"
+        out[p] = out.get(p, 0) + int(nbytes)
+    return out
+
+
+def _time_calls(fn, state, rnds, reps: int) -> float:
+    """Best per-call wall seconds over `reps` device-synced dispatches,
+    each with a DIFFERENT randomness (the identical-dispatch cache
+    defense bench.py's _time_run uses)."""
+    import time as _time
+
+    import jax
+
+    best = float("inf")
+    for i in range(max(reps, 1)):
+        rnd = rnds[i % len(rnds)]
+        t0 = _time.perf_counter()
+        jax.block_until_ready(fn(state, rnd))
+        best = min(best, _time.perf_counter() - t0)
+    return best
+
+
+def _verdict(model_unfused: float, xla_bytes: float | None,
+             dt_s: float, on_tpu: bool, hbm_gbps: float) -> str:
+    if model_unfused <= 0 or xla_bytes is None or xla_bytes <= 0:
+        return "n/a"
+    if xla_bytes > FIXABLE_RATIO * model_unfused:
+        return "fixable"
+    if on_tpu and dt_s > 0:
+        bw_frac = (xla_bytes / dt_s) / (hbm_gbps * 1e9)
+        if bw_frac < FLOOR_MIN_BW_FRAC:
+            return "fixable"
+    return "floor"
+
+
+def profile_ring(cfg, *, settle: int = 2, reps: int = 5, seed: int = 0,
+                 crash_fraction: float = 0.001, ici_devices: int = 8,
+                 trace_dir: str | None = None, top_k: int = 5) -> dict:
+    """Measure one ring-engine period's phase attribution on the current
+    backend.  Returns the report dict (see module docstring).  With
+    `trace_dir`, additionally re-runs the full step under
+    jax.profiler.trace and attaches the device top-op table
+    (report["top_ops"]) with per-op phase guesses."""
+    import jax
+    import jax.numpy as jnp
+
+    from swim_tpu.models import ring
+    from swim_tpu.obs.ici import V5E_ICI_GBPS
+    from swim_tpu.sim import faults
+    from swim_tpu.utils import roofline as rl
+
+    n = cfg.n_nodes
+    key = jax.random.key(seed)
+    plan = faults.with_random_crashes(
+        faults.none(n), jax.random.key(1), crash_fraction, 0,
+        max(settle, 1))
+    state = ring.init_state(cfg)
+    if settle > 0:      # profile a steady-state window, not a cold start
+        state = jax.block_until_ready(
+            ring.run(cfg, state, plan, key, settle))
+    # distinct randomness per timed dispatch
+    rnds = [ring.draw_period_ring(key, jnp.int32(1_000 + i), cfg)
+            for i in range(max(reps, 1))]
+
+    active = phases_for(cfg)
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+
+    def _prefix_fn(phase):
+        def fn(st, rnd):
+            pr = PhaseProbe(until=phase)
+            tap: dict = {}
+            out = ring.step(cfg, st, plan, rnd, tap=tap, prof=pr)
+            return out
+        return fn
+
+    def _full_fn(st, rnd):
+        tap: dict = {}
+        st = ring.step(cfg, st, plan, rnd, tap=tap)
+        from swim_tpu.obs.engine import frame_from_tap
+
+        return st, frame_from_tap(tap)
+
+    def _measure(fn):
+        jfn = jax.jit(fn)
+        compiled = jfn.lower(state, rnds[0]).compile()
+        jax.block_until_ready(compiled(state, rnds[0]))        # warmup
+        return (_time_calls(compiled, state, rnds, reps),
+                rl.hlo_bytes_accessed(compiled))
+
+    t_full, b_full = _measure(_full_fn)
+    prefix_t: dict[str, float] = {}
+    prefix_b: dict[str, float | None] = {}
+    for phase in active:
+        if phase == "telemetry_tap":
+            continue        # its prefix IS the full program minus nothing
+        prefix_t[phase], prefix_b[phase] = _measure(_prefix_fn(phase))
+
+    hbm = phase_hbm_model(cfg)
+    try:
+        ici = phase_ici_model(cfg, ici_devices)
+    except Exception:       # pull-mode ops without a sharded twin etc.
+        ici = {}
+    hbm_gbps, ici_gbps = rl.V5E_HBM_GBPS, V5E_ICI_GBPS
+
+    rows = []
+    prev_t, prev_b = 0.0, 0.0
+    covered = 0.0
+    for phase in active:
+        if phase == "telemetry_tap":
+            dt = max(t_full - prev_t, 0.0)
+            db = (max(b_full - prev_b, 0.0)
+                  if (b_full is not None and prev_b is not None) else None)
+        else:
+            dt = max(prefix_t[phase] - prev_t, 0.0)
+            pb = prefix_b[phase]
+            db = (max(pb - prev_b, 0.0)
+                  if (pb is not None and prev_b is not None) else None)
+            prev_t, prev_b = prefix_t[phase], pb
+        covered += dt
+        mf, mu = hbm.get(phase, (0.0, 0.0))
+        row = {
+            "phase": phase,
+            "ms": round(dt * 1e3, 4),
+            "fraction": round(dt / t_full, 4) if t_full else 0.0,
+            "hbm_model_fused_bytes": int(mf),
+            "hbm_model_unfused_bytes": int(mu),
+            "xla_bytes": int(db) if db is not None else None,
+            "ici_model_bytes": int(ici.get(phase, 0)),
+            "verdict": _verdict(mu, db, dt, on_tpu, hbm_gbps),
+        }
+        if db is not None and dt > 0:
+            row["achieved_gbps"] = round(db / dt / 1e9, 2)
+            row["hbm_ceiling_frac"] = round(db / dt / (hbm_gbps * 1e9), 4)
+        rows.append(row)
+
+    top_ops = None
+    if trace_dir:
+        jfull = jax.jit(_full_fn)
+        jax.block_until_ready(jfull(state, rnds[0]))
+        with jax.profiler.trace(trace_dir):
+            for i in range(max(reps, 1)):
+                jax.block_until_ready(jfull(state, rnds[i % len(rnds)]))
+        try:
+            top_ops = top_ops_from_trace(trace_dir, top_k=top_k)
+        except (FileNotFoundError, ValueError, KeyError) as e:
+            top_ops = {"error": f"trace parse failed: {e}"}
+
+    ceil = rl.ceiling_periods_per_sec(cfg)
+    return {
+        **({"top_ops": top_ops} if top_ops is not None else {}),
+        "nodes": n,
+        "platform_actual": platform,
+        "phases_active": list(active),
+        "step_ms": round(t_full * 1e3, 3),
+        "pps": round(1.0 / t_full, 2) if t_full else 0.0,
+        "coverage_pct": round(covered / t_full * 100.0, 2) if t_full
+        else 0.0,
+        "contract_coverage_pct": 95.0,
+        "phases": rows,
+        "xla_bytes_step": int(b_full) if b_full is not None else None,
+        "roofline": {
+            "hbm_gbps": hbm_gbps, "ici_gbps": ici_gbps,
+            "ceiling_fused_pps": round(ceil["ceiling_fused"], 1),
+            "ceiling_unfused_pps": round(ceil["ceiling_unfused"], 1),
+            "bytes_fused": int(ceil["bytes_fused"]),
+            "bytes_unfused": int(ceil["bytes_unfused"]),
+        },
+        "ici_model_devices": ici_devices,
+        "reps": reps, "settle": settle,
+        "anchor_cfg": {
+            "ring_probe": cfg.ring_probe,
+            "ring_sel_scope": cfg.ring_sel_scope,
+            "k_indirect": cfg.k_indirect,
+            "ring_window_periods": cfg.ring_window_periods,
+            "ring_view_c": cfg.ring_view_c,
+            "lifeguard": cfg.lifeguard,
+            "telemetry_tap_included": True,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# XLA-trace top-op attribution (promoted from scripts/profile_ring.py)
+# ---------------------------------------------------------------------------
+
+# op-name pattern -> (phase guess, note).  First match wins; the guess
+# inherits its phase's verdict in the rendered table and is marked as a
+# heuristic — XLA fusion names do not carry phase provenance.
+OP_PHASE_PATTERNS = (
+    ("select_", "select", "first-B selection fusion"),
+    ("copy", None, "layout/relayout copy — not in the byte model"),
+    ("all-to-all", "ppermute", "wire exchange"),
+    ("collective-permute", "ppermute", "wire exchange"),
+    ("broadcast_and", "merge", "wave OR-delivery fusion"),
+    ("and_fusion", "merge", "wave OR-delivery fusion"),
+    ("or_fusion", "merge", "wave OR-delivery fusion"),
+    ("add_maximum", "commit", "scatter-max index/verdict fusion"),
+    ("scatter", "commit", "origination/index scatter"),
+    ("gather", "commit", "query gather"),
+    ("reduce", "select", "census/selection reduction"),
+)
+
+
+def classify_op(name: str) -> tuple[str | None, str]:
+    low = name.lower()
+    for pat, phase, note in OP_PHASE_PATTERNS:
+        if pat in low:
+            return phase, note
+    return None, "unattributed fusion"
+
+
+def top_ops_from_trace(trace_dir: str, top_k: int = 25) -> dict:
+    """Parse the newest .trace.json.gz under `trace_dir`: top ops by
+    device self-time.  Returns {"trace", "total_us", "ops": [...]}."""
+    import glob
+    import gzip
+    from collections import defaultdict
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        tr = json.load(f)
+
+    proc_name: dict[int, str] = {}
+    for ev in tr.get("traceEvents", []):
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            proc_name[ev["pid"]] = ev.get("args", {}).get("name", "")
+
+    by_op: dict[str, float] = defaultdict(float)
+    count: dict[str, int] = defaultdict(int)
+    total = 0.0
+    for ev in tr.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        pname = proc_name.get(ev.get("pid"), "")
+        if ("TPU" not in pname and "/device" not in pname
+                and "Chip" not in pname and "XLA" not in pname):
+            continue
+        dur = float(ev.get("dur", 0.0))
+        name = ev.get("name", "?")
+        by_op[name] += dur
+        count[name] += 1
+        total += dur
+
+    ops = []
+    for name, us in sorted(by_op.items(), key=lambda kv: -kv[1])[:top_k]:
+        phase, note = classify_op(name)
+        ops.append({"op": name, "self_us": round(us, 1),
+                    "calls": count[name], "phase_guess": phase,
+                    "note": note})
+    return {"trace": paths[-1], "total_us": round(total, 1), "ops": ops}
+
+
+def render_report(report: dict) -> str:
+    """Human-readable attribution table (the `swim-tpu profile` view)."""
+    cov = report.get("coverage_pct", 0.0)
+    lines = [
+        f"phase attribution @ {report['nodes']} nodes "
+        f"({report['platform_actual']}) — step "
+        f"{report['step_ms']} ms, {report['pps']} periods/s, "
+        f"coverage {cov}% (contract ≥ "
+        f"{report.get('contract_coverage_pct', 95.0)}%)",
+        "",
+        f"{'phase':<14}{'ms':>9}{'frac':>8}"
+        f"{'model HBM f/u':>22}{'XLA bytes':>12}{'ICI bytes':>11}"
+        "  verdict",
+    ]
+    for row in report.get("phases", []):
+        model = (f"{row['hbm_model_fused_bytes']:,}/"
+                 f"{row['hbm_model_unfused_bytes']:,}")
+        xla = (f"{row['xla_bytes']:,}" if row.get("xla_bytes") is not None
+               else "-")
+        lines.append(
+            f"{row['phase']:<14}{row['ms']:>9.3f}{row['fraction']:>8.3f}"
+            f"{model:>22}{xla:>12}{row['ici_model_bytes']:>11,}"
+            f"  {row['verdict']}"
+            + (f" ({row['achieved_gbps']} GB/s,"
+               f" {row['hbm_ceiling_frac']:.0%} of HBM)"
+               if "achieved_gbps" in row else ""))
+    rl = report.get("roofline", {})
+    lines.append("")
+    lines.append(
+        f"roofline: HBM {rl.get('hbm_gbps')} GB/s, ICI "
+        f"{rl.get('ici_gbps')} GB/s; chip ceiling "
+        f"{rl.get('ceiling_fused_pps')}/{rl.get('ceiling_unfused_pps')} "
+        "p/s (fused/unfused)")
+    top = report.get("top_ops")
+    if isinstance(top, dict) and top.get("ops"):
+        verdict_of = {r["phase"]: r["verdict"]
+                      for r in report.get("phases", [])}
+        lines.append("")
+        lines.append(f"top device ops (trace {top.get('trace', '?')}, "
+                     f"total {top.get('total_us')} µs):")
+        lines.append(f"  {'self µs':>10} {'calls':>6}  "
+                     f"{'phase?':<10} {'verdict':<10} op")
+        for op in top["ops"]:
+            ph = op.get("phase_guess")
+            verdict = verdict_of.get(ph, "fixable" if ph is None else "n/a")
+            lines.append(
+                f"  {op['self_us']:>10.1f} {op['calls']:>6}  "
+                f"{ph or '?':<10} {verdict:<10} {op['op']}"
+                f"  [{op['note']}]")
+    elif isinstance(top, dict) and top.get("error"):
+        lines.append("")
+        lines.append(f"top device ops: {top['error']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Artifact plumbing (bridge /metrics + CLI --out share this path)
+# ---------------------------------------------------------------------------
+
+def default_artifact_path() -> str:
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo, "bench_results", "profile_phases.json")
+
+
+def load_artifact(path: str | None = None) -> dict | None:
+    """Best-effort load of the latest profile report (None if absent or
+    unreadable) — the bridge's swim_prof_* gauges read this."""
+    path = path or default_artifact_path()
+    try:
+        with open(path) as f:
+            report = json.load(f)
+        return report if isinstance(report, dict) and "phases" in report \
+            else None
+    except (OSError, ValueError):
+        return None
+
+
+def save_artifact(report: dict, path: str | None = None) -> str:
+    path = path or default_artifact_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=1)
+    os.replace(tmp, path)
+    return path

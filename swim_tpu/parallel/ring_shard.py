@@ -407,18 +407,36 @@ def mapped_step(cfg: SwimConfig, mesh):
     With cfg.telemetry the mapped step returns (state, EngineFrame):
     the tap values are psum/pmax-reduced inside ring.step, so every
     frame field is replicated — out_specs P() — and identical to the
-    single-program engine's frame for the same period."""
+    single-program engine's frame for the same period.
+
+    With cfg.profiling the step additionally returns the obs/prof.py
+    phase-marker vector (i32[len(PHASES)]); each marker is an
+    ops.gsum-reduced fold, so it too is replicated (out_spec P()).
+    Extras compose: (state, frame?, markers?) in that order."""
     d = _check(cfg, mesh)
 
-    if cfg.telemetry:
+    if cfg.telemetry or cfg.profiling:
         def _step(state, plan, rnd):
-            tap: dict = {}
-            st = ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d),
-                           tap=tap)
-            return st, frame_from_tap(tap)
+            from swim_tpu.obs.prof import PhaseProbe
 
-        out_specs = (_state_specs(cfg),
-                     EngineFrame(*(P() for _ in EngineFrame._fields)))
+            tap: dict | None = {} if cfg.telemetry else None
+            pr = PhaseProbe() if cfg.profiling else None
+            st = ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d),
+                           tap=tap, prof=pr)
+            extras = []
+            if cfg.telemetry:
+                extras.append(frame_from_tap(tap))
+            if cfg.profiling:
+                extras.append(pr.marker_vector())
+            return (st, *extras)
+
+        extra_specs = []
+        if cfg.telemetry:
+            extra_specs.append(
+                EngineFrame(*(P() for _ in EngineFrame._fields)))
+        if cfg.profiling:
+            extra_specs.append(P())
+        out_specs = (_state_specs(cfg), *extra_specs)
     else:
         def _step(state, plan, rnd):
             return ring.step(cfg, state, plan, rnd, ops=ShardOps(cfg, d))
@@ -442,18 +460,20 @@ def build_run(cfg: SwimConfig, mesh, periods: int):
 
     With cfg.telemetry returns (state, EngineFrame) where every frame
     field is a [periods]-stacked i32 series (the flight-recorder feed);
-    otherwise just the final state."""
+    with cfg.profiling the [periods, len(PHASES)] marker matrix is
+    appended; otherwise just the final state."""
     sm = mapped_step(cfg, mesh)
+    extras = cfg.telemetry or cfg.profiling
 
     def run(state, plan, root_key):
         def body(stt, _):
             rnd = ring.draw_period_ring(root_key, stt.step, cfg)
             out = sm(stt, plan, rnd)
-            if cfg.telemetry:
-                return out
+            if extras:
+                return out[0], out[1:]
             return out, None
 
-        out, frames = jax.lax.scan(body, state, None, length=periods)
-        return (out, frames) if cfg.telemetry else out
+        out, ys = jax.lax.scan(body, state, None, length=periods)
+        return (out, *ys) if extras else out
 
     return jax.jit(run)

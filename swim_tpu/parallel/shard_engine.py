@@ -55,6 +55,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+try:
+    from jax import shard_map as _shard_map      # jax >= 0.8
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+except ImportError:                              # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
 from swim_tpu.config import SwimConfig
 from swim_tpu.models import rumor
 from swim_tpu.models.rumor import RumorRandomness, RumorState
@@ -582,10 +591,10 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
             sent_node=snode, sent_time=stime, confirmed=confirmed,
             overflow=overflow, step=t + 1)
 
-    smapped = jax.shard_map(
+    smapped = shard_map(
         shard_body, mesh=mesh,
         in_specs=(node_specs, plan_specs, rnd_specs),
-        out_specs=node_specs, check_vma=False)
+        out_specs=node_specs, check_rep=False)
     jitted = jax.jit(smapped)
 
     def stepper(state: RumorState, plan: FaultPlan, rnd):

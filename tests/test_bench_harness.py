@@ -326,3 +326,40 @@ class TestWatcherCaptureChecks:
         _R.stdout = '{"platform": "default", "value": 9.0}\n'
         assert tw.run_save("probe", ["x"], 5.0,
                            check=tw._bench_on_tpu) is True
+
+
+class TestRingTierRegistry:
+    """TIER_FNS and RING_TIER_CFGS must describe the SAME configs — the
+    tally the child's self-describing report is built from (VERDICT r6
+    #5: the pull engine used to be measured only through ad-hoc scripts,
+    so a registered tier whose partial drifted from its advertised cfg
+    would silently mislabel the headline)."""
+
+    def test_partial_kwargs_match_advertised_cfg(self):
+        import functools
+
+        for tier, cfg_kw in bench.RING_TIER_CFGS.items():
+            fn = bench.TIER_FNS[tier]
+            kw = fn.keywords if isinstance(fn, functools.partial) else {}
+            assert kw == cfg_kw, (
+                f"tier {tier!r}: TIER_FNS binds {kw} but RING_TIER_CFGS "
+                f"advertises {cfg_kw}")
+
+    def test_ringpull_is_registered_pull_probe(self):
+        """The 1M pull-mode number now comes from the registered
+        harness, not an ad-hoc script: the tier exists, binds
+        ring_probe='pull', and its advertised cfg builds a valid
+        SwimConfig."""
+        from swim_tpu import SwimConfig
+
+        assert "ringpull" in bench.TIER_FNS
+        assert bench.RING_TIER_CFGS["ringpull"] == {"ring_probe": "pull"}
+        cfg = SwimConfig(n_nodes=256, **bench.RING_TIER_CFGS["ringpull"])
+        assert cfg.ring_probe == "pull"
+
+    def test_every_ring_tier_cfg_constructs(self):
+        from swim_tpu import SwimConfig
+
+        for tier, cfg_kw in bench.RING_TIER_CFGS.items():
+            cfg = SwimConfig(n_nodes=256, **cfg_kw)
+            assert cfg.n_nodes == 256, tier

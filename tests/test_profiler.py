@@ -1,0 +1,352 @@
+"""Performance-observatory contracts (obs/prof.py + obs/trend.py).
+
+Three contract families:
+
+1. **Bitwise parity** — threading a PhaseProbe (marker mode) through any
+   engine's step must leave the protocol state bitwise identical to the
+   unprofiled step, and `profiled_ring_run` must reproduce `ring.run`'s
+   final state exactly.  prof=None is the default, so profiling-off IS
+   the unchanged program — the pin here is that profiling-ON changes
+   nothing either.
+2. **Attribution coverage** — the prefix-differenced phase timings must
+   cover ≥95% of the measured step wall time (the deltas telescope by
+   construction; this pins that the cut placement actually spans the
+   step).
+3. **Trend gate** — golden tests of the jax-free bench-trajectory
+   engine over a synthetic bench_results/ fixture: last-good semantics,
+   the >10% regression threshold, advisory (round-less) captures, and
+   vacuous passes.
+
+Plus surface pins: the swim_prof_* exposition (render_profile), the
+artifact plumbing the bridge /metrics reads, and the phase byte models.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from swim_tpu import SwimConfig
+from swim_tpu.obs import prof, trend
+from swim_tpu.sim import faults
+
+SMALL = dict(suspicion_mult=1.0, k_indirect=1, max_piggyback=2,
+             ring_window_periods=2, ring_view_c=2)
+
+
+def _crashy_plan(n):
+    return faults.with_loss(
+        faults.with_crashes(faults.none(n), [3, n - 5], [2, 5]), 0.05)
+
+
+# ---------------------------------------------------------------------------
+# jax-free: probe basics, phase tables, op classification
+# ---------------------------------------------------------------------------
+
+class TestProbeBasics:
+    def test_unknown_phase_rejected(self):
+        with pytest.raises(ValueError, match="unknown phase"):
+            prof.PhaseProbe(until="warp")
+
+    def test_phase_tables_consistent(self):
+        # every HBM term maps to a canonical phase; every gauge name is
+        # prefixed swim_prof_ (the exposition lint rides on this)
+        assert set(prof.HBM_TERM_PHASE.values()) <= set(prof.PHASES)
+        assert all(g.startswith("swim_prof_") for g in prof.PROF_GAUGES)
+
+    def test_phases_for_fused_vs_coarse(self):
+        fused = SwimConfig(n_nodes=64, ring_sel_scope="period", **SMALL)
+        assert set(prof.phases_for(fused)) == set(prof.PHASES)
+        for coarse in (SwimConfig(n_nodes=64, **SMALL),          # wave scope
+                       SwimConfig(n_nodes=64, ring_probe="pull")):
+            phases = prof.phases_for(coarse)
+            assert phases == ("select", "merge", "commit",
+                              "telemetry_tap")
+
+    def test_classify_op(self):
+        assert prof.classify_op("select_reduce_fusion.11")[0] == "select"
+        assert prof.classify_op("collective-permute.3")[0] == "ppermute"
+        assert prof.classify_op("copy.306")[0] is None
+        assert prof.classify_op("add_maximum_fusion.5")[0] == "commit"
+        assert prof.classify_op("wat.7") == (None, "unattributed fusion")
+
+
+class TestPhaseByteModels:
+    def test_hbm_model_partitions_roofline_terms(self):
+        """The per-phase HBM model is a PARTITION of ring_traffic's
+        per-term accounting: phase sums must equal the term totals, for
+        the fused and the coarse phase set alike."""
+        from swim_tpu.utils import roofline as rl
+
+        for cfg in (SwimConfig(n_nodes=256, ring_sel_scope="period",
+                               **SMALL),
+                    SwimConfig(n_nodes=256, **SMALL)):
+            tr = rl.ring_traffic(cfg)
+            model = prof.phase_hbm_model(cfg)
+            assert set(model) == set(prof.phases_for(cfg))
+            assert sum(f for f, _ in model.values()) == \
+                pytest.approx(sum(f for f, _ in tr["terms"].values()))
+            assert sum(u for _, u in model.values()) == \
+                pytest.approx(sum(u for _, u in tr["terms"].values()))
+
+    def test_ici_model_partitions_collective_tally(self):
+        from swim_tpu.obs.ici import trace_ici_bytes
+
+        cfg = SwimConfig(n_nodes=256, ring_sel_scope="period", **SMALL)
+        tally = trace_ici_bytes(cfg, 8)
+        model = prof.phase_ici_model(cfg, 8)
+        assert set(model) <= set(prof.phases_for(cfg))
+        assert sum(model.values()) == sum(tally["breakdown"].values())
+
+
+# ---------------------------------------------------------------------------
+# parity: marker mode changes no state bit, on any engine
+# ---------------------------------------------------------------------------
+
+class TestMarkerParity:
+    @pytest.mark.parametrize("engine", ["ring", "rumor", "dense"])
+    def test_state_parity(self, engine):
+        import jax
+
+        from swim_tpu.models import dense, ring, rumor
+        from swim_tpu.utils.prng import draw_period
+
+        mod = {"ring": ring, "rumor": rumor, "dense": dense}[engine]
+        draw = {"ring": ring.draw_period_ring,
+                "rumor": rumor.draw_period_rumor,
+                "dense": draw_period}[engine]
+        n = 64
+        kw = SMALL if engine == "ring" else {}
+        cfg = SwimConfig(n_nodes=n, **kw)
+        plan = _crashy_plan(n)
+        key = jax.random.key(3)
+        off = on = mod.init_state(cfg)
+        for t in range(8):
+            rnd = draw(key, t, cfg)
+            off = mod.step(cfg, off, plan, rnd)
+            pr = prof.PhaseProbe()
+            on = mod.step(cfg, on, plan, rnd, prof=pr)
+            # every phase the engine cut left a marker; select/commit
+            # exist on all three engines
+            assert {"select", "commit"} <= set(pr.markers), engine
+            for name in off._fields:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(off, name)),
+                    np.asarray(getattr(on, name)),
+                    err_msg=f"{engine}:{name} @ period {t}")
+
+    def test_profiled_ring_run_matches_ring_run(self):
+        """The bench profiler on-arm: final state bitwise equal to
+        ring.run, markers stacked [T, len(PHASES)] with live signatures
+        for exactly the active phases."""
+        import jax
+
+        from swim_tpu.models import ring
+
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         profiling=True, **SMALL)
+        plan = _crashy_plan(n)
+        key = jax.random.key(5)
+        ref = jax.block_until_ready(
+            ring.run(cfg, ring.init_state(cfg), plan, key, 6))
+        out = jax.block_until_ready(
+            prof.profiled_ring_run(cfg, ring.init_state(cfg), plan,
+                                   key, 6))
+        for name in ref._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, name)),
+                np.asarray(getattr(out.state, name)), err_msg=name)
+        markers = np.asarray(out.markers)
+        assert markers.shape == (6, len(prof.PHASES))
+        # .step proxies the state's counter (bench _time_run's proof)
+        assert int(out.step) == int(ref.step)
+
+    def test_prefix_mode_returns_captured_live_set(self):
+        import jax
+
+        from swim_tpu.models import ring
+
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period", **SMALL)
+        plan = _crashy_plan(n)
+        rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
+        st = ring.init_state(cfg)
+        for phase in ("select", "commit"):
+            pr = prof.PhaseProbe(until=phase)
+            out = ring.step(cfg, st, plan, rnd, prof=pr)
+            assert out is pr.captured, phase
+            assert "_probe" in out, phase
+            assert "win" in out, phase
+
+
+# ---------------------------------------------------------------------------
+# attribution coverage (compile-heavy: one jit per prefix boundary)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestCoverageContract:
+    def test_small_anchor_coverage(self):
+        cfg = SwimConfig(n_nodes=512, ring_sel_scope="period", **SMALL)
+        report = prof.profile_ring(cfg, settle=1, reps=3)
+        assert report["phases_active"] == list(prof.phases_for(cfg))
+        assert {r["phase"] for r in report["phases"]} == \
+            set(report["phases_active"])
+        assert report["coverage_pct"] >= report["contract_coverage_pct"]
+        # fractions are the per-phase shares of the measured step
+        assert report["step_ms"] > 0
+        for row in report["phases"]:
+            assert row["verdict"] in ("floor", "fixable", "n/a")
+
+
+# ---------------------------------------------------------------------------
+# trend engine goldens (jax-free)
+# ---------------------------------------------------------------------------
+
+def _write_round(repo, rnd, pps, tier="ring", nodes=65536,
+                 platform="cpu"):
+    doc = {"parsed": {f"{tier}_periods_per_sec": pps,
+                      f"{tier}_nodes": nodes, "platform": platform}}
+    with open(os.path.join(repo, f"BENCH_r{rnd:02d}.json"), "w") as f:
+        json.dump(doc, f)
+
+
+def _write_capture(repo, pps, tier="ring", nodes=65536, platform="cpu",
+                   name="bench_all.json", captured_at="2026-01-01"):
+    d = os.path.join(repo, "bench_results")
+    os.makedirs(d, exist_ok=True)
+    doc = {"result": {f"{tier}_periods_per_sec": pps,
+                      f"{tier}_nodes": nodes, "platform": platform},
+           "captured_at": captured_at}
+    with open(os.path.join(d, name), "w") as f:
+        json.dump(doc, f)
+
+
+class TestTrendEngine:
+    def test_last_good_semantics_pass(self, tmp_path):
+        """Latest vs the IMMEDIATELY PREVIOUS round — an all-time-best
+        earlier round must not fail a series that recovered."""
+        repo = str(tmp_path)
+        for rnd, pps in ((2, 4.2), (3, 3.8), (4, 3.75)):
+            _write_round(repo, rnd, pps)
+        checks = trend.check(trend.series(trend.collect(repo)))
+        assert len(checks) == 1
+        c = checks[0]
+        # 3.75 vs last-good 3.8 is a 1.3% drop: ok, even though the
+        # all-time best 4.2 would read as an 10.7% drop
+        assert c["ok"] and c["last_good_round"] == 3
+
+    def test_regression_fails_gate(self, tmp_path):
+        repo = str(tmp_path)
+        _write_round(repo, 1, 10.0)
+        _write_round(repo, 2, 8.5)          # 15% drop
+        summary = trend.summarize(repo)
+        assert not summary["ok"]
+        assert summary["checks"][0]["drop_pct"] == 15.0
+        assert trend.main(["--repo", repo, "--check", "--json"]) == 1
+
+    def test_exactly_threshold_passes(self, tmp_path):
+        repo = str(tmp_path)
+        _write_round(repo, 1, 10.0)
+        _write_round(repo, 2, 9.0)          # exactly 10% — not > 10%
+        assert trend.summarize(repo)["ok"]
+
+    def test_captures_are_advisory(self, tmp_path):
+        """A terrible round-less capture renders in the trajectory but
+        never trips the gate (its position vs rounds is ambiguous)."""
+        repo = str(tmp_path)
+        _write_round(repo, 1, 10.0)
+        _write_round(repo, 2, 9.8)
+        _write_capture(repo, 0.5)
+        summary = trend.summarize(repo)
+        assert summary["ok"]
+        (key,) = summary["series"]
+        assert len(summary["series"][key]) == 3
+        assert "0.5" in trend.render(summary)
+
+    def test_vacuous_pass_and_series_isolation(self, tmp_path):
+        """<2 rounds = nothing to judge; different nodes/platform are
+        different series and never compare."""
+        repo = str(tmp_path)
+        _write_round(repo, 1, 10.0)
+        _write_round(repo, 2, 1.0, nodes=1_000_000)       # other series
+        _write_round(repo, 3, 100.0, platform="tpu")      # other series
+        assert trend.check(trend.series(trend.collect(repo))) == []
+        assert trend.summarize(repo)["ok"]
+
+    def test_garbage_artifacts_skipped(self, tmp_path):
+        repo = str(tmp_path)
+        with open(os.path.join(repo, "BENCH_r01.json"), "w") as f:
+            f.write("{not json")
+        _write_round(repo, 2, 5.0)
+        samples = trend.collect(repo)
+        assert [s["round"] for s in samples] == [2]
+
+
+# ---------------------------------------------------------------------------
+# exposition + artifact plumbing
+# ---------------------------------------------------------------------------
+
+def _synthetic_report():
+    return {
+        "nodes": 65536, "platform_actual": "cpu",
+        "phases_active": ["select", "commit", "telemetry_tap"],
+        "step_ms": 10.0, "pps": 100.0, "coverage_pct": 98.5,
+        "contract_coverage_pct": 95.0,
+        "phases": [
+            {"phase": "select", "ms": 4.0, "fraction": 0.4,
+             "hbm_model_fused_bytes": 1000,
+             "hbm_model_unfused_bytes": 2000, "xla_bytes": 1500,
+             "ici_model_bytes": 0, "verdict": "floor",
+             "achieved_gbps": 0.4, "hbm_ceiling_frac": 0.0005},
+            {"phase": "commit", "ms": 5.5, "fraction": 0.55,
+             "hbm_model_fused_bytes": 3000,
+             "hbm_model_unfused_bytes": 6000, "xla_bytes": None,
+             "ici_model_bytes": 64, "verdict": "n/a"},
+        ],
+        "xla_bytes_step": 12345,
+        "roofline": {"hbm_gbps": 819.0, "ici_gbps": 45.0,
+                     "ceiling_fused_pps": 100.0,
+                     "ceiling_unfused_pps": 50.0,
+                     "bytes_fused": 1, "bytes_unfused": 2},
+    }
+
+
+class TestExposition:
+    def test_render_profile_emits_every_gauge(self):
+        from swim_tpu.obs.expo import render_profile
+
+        text = render_profile(_synthetic_report())
+        for gauge in prof.PROF_GAUGES:
+            assert f"# TYPE {gauge} gauge" in text, gauge
+        assert 'nodes="65536"' in text and 'platform="cpu"' in text
+        assert 'phase="select"' in text
+        # None xla_bytes rows are omitted, not rendered as "None"
+        assert "None" not in text
+        assert 'bracket="fused"' in text and 'bracket="unfused"' in text
+
+    def test_render_report_table(self):
+        text = prof.render_report(_synthetic_report())
+        assert "coverage 98.5%" in text
+        assert "floor" in text and "select" in text
+
+    def test_artifact_roundtrip_and_bestefort_load(self, tmp_path):
+        path = str(tmp_path / "profile_phases.json")
+        report = _synthetic_report()
+        assert prof.save_artifact(report, path) == path
+        assert prof.load_artifact(path)["nodes"] == 65536
+        assert prof.load_artifact(str(tmp_path / "absent.json")) is None
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write("{}")          # a dict but not a report
+        assert prof.load_artifact(bad) is None
+
+    def test_registry_lint_covers_prof_gauges(self):
+        from scripts.check_metrics_registry import check_prof_gauges
+
+        assert check_prof_gauges() == []

@@ -39,7 +39,9 @@ def run_both(cfg, plan, periods, seed=7, shard_cfgs=()):
     arms = []
     for c in (cfg, *shard_cfgs):
         st, pl = ring_shard.place(c, mesh, ring.init_state(c), plan)
-        label = c.ring_ici_wire + ("+telemetry" if c.telemetry else "")
+        label = (c.ring_ici_wire
+                 + ("+telemetry" if c.telemetry else "")
+                 + ("+profiling" if c.profiling else ""))
         arms.append({"label": label, "state": st, "plan": pl,
                      "step": ring_shard.build_step(c, mesh)})
     g_step = jax.jit(lambda s, r: ring.step(cfg, s, plan, r))
@@ -154,6 +156,24 @@ class TestBitwiseVsGlobal:
                  shard_cfgs=(cfg.replace(telemetry=True),
                              cfg.replace(telemetry=True,
                                          ring_ici_wire="compact")))
+
+    @pytest.mark.slow  # extra shard_map compiles; single-program parity
+    # is pinned fast in tests/test_profiler.py, this sharded depth runs
+    # via scripts/run_suite.py
+    def test_profiling_parity(self):
+        """Profiler tri-run (performance-observatory tentpole): the
+        profiling-on shard — alone AND stacked with telemetry — must
+        keep the protocol state bitwise identical to the profiling-off
+        single-program reference under crash + loss.  The phase-marker
+        folds (obs/prof.py marker mode) are pure output: they may never
+        touch a state bit."""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period", **SMALL_GEOM)
+        plan = faults.with_loss(
+            faults.with_crashes(faults.none(n), [5, 40], [2, 6]), 0.1)
+        run_both(cfg, plan, 10, seed=9,
+                 shard_cfgs=(cfg.replace(profiling=True),
+                             cfg.replace(profiling=True, telemetry=True)))
 
     def test_pull_mode(self):
         """Sharded pull-uniform probing (round 4; VERDICT r3 item 7's

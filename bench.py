@@ -440,12 +440,15 @@ def bench_shard(n_nodes: int, periods: int, warmup: int = 1,
 def bench_ring_shard(n_nodes: int, periods: int, warmup: int = 2,
                      crash_fraction: float = 0.001,
                      ring_sel_scope: str = "wave",
-                     ring_ici_wire: str = "window") -> float:
+                     ring_ici_wire: str = "window",
+                     ring_scalar_wire: str = "wide") -> float:
     """Explicitly-sharded ring engine (shard_map + ppermute rolls) —
     the production multi-chip path; on one chip it degenerates to the
     plain ring step.  The 'ringshardc' tier is this same harness with
-    ring_sel_scope='period' + ring_ici_wire='compact' (the bounded-
-    piggyback ICI wire — the multi-chip throughput configuration)."""
+    ring_sel_scope='period' + ring_ici_wire='compact' +
+    ring_scalar_wire='packed' (bounded-piggyback sel wire plus the
+    bit/byte-packed scalar wave bundles — the multi-chip throughput
+    configuration)."""
     import jax
 
     from swim_tpu import SwimConfig
@@ -454,7 +457,8 @@ def bench_ring_shard(n_nodes: int, periods: int, warmup: int = 2,
     from swim_tpu.sim import faults
 
     cfg = SwimConfig(n_nodes=n_nodes, ring_sel_scope=ring_sel_scope,
-                     ring_ici_wire=ring_ici_wire)
+                     ring_ici_wire=ring_ici_wire,
+                     ring_scalar_wire=ring_scalar_wire)
     mesh = pmesh.make_mesh()
     plan = faults.with_random_crashes(
         faults.none(n_nodes), jax.random.key(1), crash_fraction,
@@ -588,7 +592,8 @@ TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "ringshard": bench_ring_shard,
             "ringshardc": functools.partial(bench_ring_shard,
                                             ring_sel_scope="period",
-                                            ring_ici_wire="compact")}
+                                            ring_ici_wire="compact",
+                                            ring_scalar_wire="packed")}
 
 # ring-family tiers: the SwimConfig knobs each one benches, shared by
 # the tier body (via TIER_FNS partials) and the child's self-describing
@@ -598,7 +603,8 @@ RING_TIER_CFGS = {
     "ringp": {"ring_sel_scope": "period"},
     "ringpull": {"ring_probe": "pull"},
     "ringshard": {},
-    "ringshardc": {"ring_sel_scope": "period", "ring_ici_wire": "compact"},
+    "ringshardc": {"ring_sel_scope": "period", "ring_ici_wire": "compact",
+                   "ring_scalar_wire": "packed"},
 }
 
 
@@ -663,6 +669,7 @@ def run_tier_child(args) -> int:
                              **RING_TIER_CFGS[args._tier])
             out["ring_sel_scope"] = cfg.ring_sel_scope
             out["ring_ici_wire"] = cfg.ring_ici_wire
+            out["ring_scalar_wire"] = cfg.ring_scalar_wire
             ceil = rl.ceiling_periods_per_sec(cfg)
             out["devices"] = len(jax.devices())
             # Physical-plausibility guard: the step is HBM-bound, so a
@@ -870,6 +877,8 @@ def main() -> int:
                      if head.get("ring_sel_scope") == "period" else "")
         wire_txt = ("compact-ici, "
                     if head.get("ring_ici_wire") == "compact" else "")
+        wire_txt += ("packed-scalar, "
+                     if head.get("ring_scalar_wire") == "packed" else "")
         metric = (f"simulated protocol-periods/sec @ {head['nodes']} nodes "
                   f"({head_tier} engine, {probe_txt}{scope_txt}{wire_txt}"
                   f"{platform})")
@@ -889,6 +898,7 @@ def main() -> int:
         out["ring_probe"] = head["ring_probe"]
         out["ring_sel_scope"] = head.get("ring_sel_scope", "wave")
         out["ring_ici_wire"] = head.get("ring_ici_wire", "window")
+        out["ring_scalar_wire"] = head.get("ring_scalar_wire", "wide")
         out["v5e_chip_ceiling_pps"] = head["v5e_chip_ceiling_pps"]
         out["bytes_per_period"] = head["bytes_per_period"]
         if on_tpu:

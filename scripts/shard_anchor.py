@@ -17,13 +17,17 @@ projection where EVERY term is measured or trace-derived:
   * **ICI term (TRACE-DERIVED), per wire format.**  A CountingOps shim
     tallies, during one abstract trace of `ring.step` at the FULL 1M
     size, exactly the bytes the sharded twin (parallel/ring_shard.py
-    ShardOps) would move per chip per period, for BOTH values of
-    `cfg.ring_ici_wire`: the dense "window" wire (2 u32[S, WW]
-    neighbor blocks per wave roll) and the "compact" wire (the
+    ShardOps) would move per chip per period, for all four
+    (sel wire, scalar wire) combos: `cfg.ring_ici_wire` "window" (2
+    dense u32[S, WW] neighbor blocks per wave roll) vs "compact" (the
     first-B piggyback packed as slot indices, ops/wavepack.py — one
     [S, B] narrow-int block per wave plus one shared boundary fetch
-    per period).  Plus psum payloads for reductions/replicated
-    gathers and the [D, kl] candidate all_gather.
+    per period), crossed with `cfg.ring_scalar_wire` "wide" (each
+    per-wave scalar vector rolls at its storage dtype) vs "packed"
+    (ok chains ride 1 bit/node and buddy payloads as byte codes,
+    fused into one ppermute bundle per wave).  Plus psum payloads for
+    reductions/replicated gathers and the [D, kl] candidate
+    all_gather.
 
 **ICI time model (deliberate serial-link lower bound).**  Every tally
 is the per-chip RECEIVED payload bytes per period (a window roll
@@ -79,6 +83,31 @@ ARMS = {
                  retransmit_mult=2.0, k_indirect=1,
                  ring_window_periods=3, ring_view_c=2),
 }
+
+# (sel wire, scalar wire) combos traced per arm.  The bare keys keep
+# the pre-packed-scalar artifact/test vocabulary ("window", "compact"
+# == wide scalar wire); "+packed" adds ring_scalar_wire="packed".
+WIRES = {
+    "window": ("window", "wide"),
+    "compact": ("compact", "wide"),
+    "window+packed": ("window", "packed"),
+    "compact+packed": ("compact", "packed"),
+}
+
+# Combined scalar roll bytes (every roll_* term except the sel-window
+# waves) per chip per period BEFORE this PR's scalar-wire work — the
+# committed pre-PR artifact's roll[1000000,{int32,uint32,bool}] sums
+# (int32 pid + view-slot rolls, u32 gone/top-key rolls, bool flag
+# rolls).  The denominator for scalar_roll_reduction_vs_pre_pr.
+PRE_PR_SCALAR_ROLL_BYTES = {"ringp": 24_750_000, "lean": 12_750_000}
+
+
+def scalar_roll_bytes(breakdown: dict) -> int:
+    """Combined scalar-roll bytes in a trace breakdown: the named
+    roll_* terms minus the sel-window wave payloads (which belong to
+    ring_ici_wire, not the scalar wire)."""
+    return sum(v for k, v in breakdown.items()
+               if k.startswith("roll") and k != "roll_sel_waves")
 
 
 def _match_mult(base: float, want: "dict[float, int]") -> float:
@@ -178,20 +207,24 @@ def main() -> int:
         # the tier-1 regression runs in seconds
         chip = None if smoke else measure_chip(cfg)
         wires = {}
-        for wire in ("window", "compact"):
-            ici = trace_ici_bytes(full.replace(ring_ici_wire=wire))
-            w = {"ici_traced": ici}
+        for label, (wire, scalar) in WIRES.items():
+            ici = trace_ici_bytes(full.replace(ring_ici_wire=wire,
+                                               ring_scalar_wire=scalar))
+            w = {"ici_traced": ici,
+                 "scalar_roll_bytes": scalar_roll_bytes(ici["breakdown"])}
             if chip is not None:
                 t_chip, t_ici = chip["t_chip_ms"], ici["t_ici_ms"]
                 w["projected_v5e8_pps_overlap"] = round(
                     1e3 / max(t_chip, t_ici), 1)
                 w["projected_v5e8_pps_serial"] = round(
                     1e3 / (t_chip + t_ici), 1)
-            wires[wire] = w
+            wires[label] = w
         red = (wires["window"]["ici_traced"]["breakdown"]
                ["roll_sel_waves"]
                / wires["compact"]["ici_traced"]["breakdown"]
                ["roll_sel_waves"])
+        sred = (PRE_PR_SCALAR_ROLL_BYTES[name]
+                / wires["compact+packed"]["scalar_roll_bytes"])
         arms[name] = {
             "geometry": {"ww": g.ww, "rw": g.rw, "c": g.c,
                          "k": cfg.k_indirect,
@@ -200,6 +233,8 @@ def main() -> int:
             "chip_measured": chip,
             "wires": wires,
             "roll_sel_waves_reduction": round(red, 2),
+            "scalar_roll_bytes_pre_pr": PRE_PR_SCALAR_ROLL_BYTES[name],
+            "scalar_roll_reduction_vs_pre_pr": round(sred, 2),
         }
         print(json.dumps({name: arms[name]}), flush=True)
     out = {
@@ -226,12 +261,22 @@ def main() -> int:
             "which covers un-modeled multi-hop forwarding)",
             "dispatch excluded: the ~66 ms/dispatch here is the axon "
             "tunnel tax; on-pod dispatch is local",
-            "north-star verdict = projected lean arm vs 10,000 p/s; "
-            "ici_ceiling verdict is chip-independent (wire bytes only)",
+            "north-star verdict = projected lean arm on the "
+            "compact+packed wire vs 10,000 p/s; ici_ceiling verdict is "
+            "chip-independent (wire bytes only)",
+            "scalar wire (ring_scalar_wire): '+packed' combos fuse each "
+            "wave's scalars into one bit/byte-packed ppermute bundle "
+            "(ok chains 1 bit/node, buddy cols/vals byte codes — "
+            "ops/wavepack.py pack_bundle); scalar_roll_bytes sums every "
+            "roll_* term except the sel-window waves, and "
+            "scalar_roll_reduction_vs_pre_pr divides the pre-PR "
+            "artifact's combined scalar roll bytes by the packed arm's "
+            "(the upstream u8 partition ids and the deferred-verdict "
+            "view query shrink the wide wire too)",
         ],
     }
     ns = arms.get("lean", arms.get("ringp"))
-    ns_wire = (ns or {}).get("wires", {}).get("compact", {})
+    ns_wire = (ns or {}).get("wires", {}).get("compact+packed", {})
     ovl = ns_wire.get("projected_v5e8_pps_overlap")
     out["north_star_within_overlap_projection"] = (
         None if ovl is None else bool(ovl >= NORTH_STAR_PPS))

@@ -177,6 +177,14 @@ CAPTURES: list = [
      ["bench.py", "--tier", "ringp", "--nodes", "16000000",
       "--periods", "8", "--tier-timeout", "1500"], 1800, False,
      lambda p: p.get("platform") not in (None, "cpu")),
+    # Multi-chip throughput wire at 1M: compact sel + packed scalar
+    # bundles (ring_ici_wire="compact" + ring_scalar_wire="packed") —
+    # the real-pod measurement behind the shard-anchor ICI projection's
+    # compact+packed arm.
+    ("ringshardc_1m",
+     ["bench.py", "--tier", "ringshardc", "--nodes", "1000000",
+      "--periods", "50", "--tier-timeout", "1500"], 1800, False,
+     _bench_on_tpu),
     # Detection law beyond the XLA-CPU envelope (which aborts at 8M):
     # pull-probe ring engine at 10M on real hardware.  The flight-record
     # dump lets _attach_analysis enrich the capture with the offline

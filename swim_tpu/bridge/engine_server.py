@@ -443,7 +443,7 @@ class EngineBridgeServer:
             self._plan = FaultPlan(
                 crash_step=jnp.asarray(crash),
                 loss=jnp.float32(loss),
-                partition_id=jnp.zeros((self.n,), jnp.int32),
+                partition_id=jnp.zeros((self.n,), jnp.uint8),
                 partition_start=jnp.int32(1 << 30),
                 partition_end=jnp.int32(1 << 30),
                 join_step=jnp.asarray(join))

@@ -160,6 +160,25 @@ class SwimConfig:
     #                              period-scope path (sel is selected
     #                              once per period; wave scope re-packs
     #                              per wave and pull mode has no waves).
+    ring_scalar_wire: str = "wide"  # per-wave SCALAR payload format on
+    #                              the sharded wave exchange (the ok
+    #                              chains, partition ids, buddy
+    #                              col/val rows and view-query vectors
+    #                              that ride alongside the sel window;
+    #                              inert in the single-program engine).
+    #                              "wide" rolls each vector separately
+    #                              at its storage dtype. "packed"
+    #                              bit-packs bool chains to 1 bit/node
+    #                              (SWIM's delivery flags are single
+    #                              bits), narrow-encodes slot/buddy
+    #                              payloads (ops/wavepack.py
+    #                              code_dtype), and fuses each wave's
+    #                              scalars into ONE u8 ppermute payload
+    #                              (pack_bundle) — bitwise-equal after
+    #                              receiver-side unpack, ~3x fewer
+    #                              scalar ICI bytes. Requires the fused
+    #                              rotor period-scope path (the bundle
+    #                              rides the fused wave staging).
 
     def __post_init__(self):
         if self.n_nodes < 2:
@@ -215,6 +234,26 @@ class SwimConfig:
             if 2 + 4 * self.k_indirect > 32:
                 raise ValueError(
                     f"ring_ici_wire='compact' is impossible at "
+                    f"k_indirect={self.k_indirect}: it rides the fused "
+                    f"period-scope merge, whose 2+4k="
+                    f"{2 + 4 * self.k_indirect} wave-ok bits must pack "
+                    "into one u32 lane mask (k_indirect <= 7)")
+        if self.ring_scalar_wire not in ("wide", "packed"):
+            raise ValueError(
+                f"bad ring_scalar_wire {self.ring_scalar_wire!r}")
+        if self.ring_scalar_wire == "packed":
+            if not (self.ring_probe == "rotor"
+                    and self.ring_sel_scope == "period"):
+                raise ValueError(
+                    "ring_scalar_wire='packed' requires ring_probe="
+                    "'rotor' and ring_sel_scope='period': the packed "
+                    "scalar bundle rides the fused period-scope wave "
+                    "staging (one ppermute payload per wave) — wave "
+                    "scope delivers in-line per wave and pull mode "
+                    "exchanges by gather, not rolls")
+            if 2 + 4 * self.k_indirect > 32:
+                raise ValueError(
+                    f"ring_scalar_wire='packed' is impossible at "
                     f"k_indirect={self.k_indirect}: it rides the fused "
                     f"period-scope merge, whose 2+4k="
                     f"{2 + 4 * self.k_indirect} wave-ok bits must pack "

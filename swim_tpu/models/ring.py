@@ -106,7 +106,7 @@ import jax
 import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
-from swim_tpu.ops import coldsel, lattice, sampling, selb, wavemerge
+from swim_tpu.ops import coldsel, lattice, sampling, selb, wavemerge, wavepack
 from swim_tpu.sim.faults import FaultPlan
 
 WORD = 32
@@ -637,9 +637,23 @@ class GlobalOps:
         return partial
 
     # -- communication ----------------------------------------------------
-    def roll_from(self, x, d):
-        """Value of x at node (i + d) mod n, for every local row i."""
+    def roll_from(self, x, d, label=None):
+        """Value of x at node (i + d) mod n, for every local row i.
+
+        `label` names the roll for the per-collective ICI byte tally
+        (obs/ici.py CountingOps) — stable keys like "roll_ok_waves"
+        instead of shape/dtype-derived ones; inert here."""
+        del label
         return jnp.roll(x, -d, axis=0)
+
+    def roll_bundle(self, parts, d, labels=None):
+        """roll_from over several same-offset node vectors at once —
+        the packed scalar wire's fusion seam (ring_scalar_wire): the
+        sharded twin ships ONE bit/byte-packed ppermute payload per
+        call (ops/wavepack.py pack_bundle); here the node axis is one
+        address space, so each part just rolls."""
+        del labels
+        return tuple(jnp.roll(x, -d, axis=0) for x in parts)
 
     # -- node-axis scatter/gather by GLOBAL node id -----------------------
     def scatter_max(self, dst, idx, val):
@@ -1004,22 +1018,44 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # a not-yet-joined target is in nobody's membership list: idle.
         # (joined[target] is a rotation — roll, never gather: see
         # _col_select_multi's docstring for the measured cost gap.)
-        prober = active & roll_from(joined, s_off)
+        prober = active & roll_from(joined, s_off, label="roll_probe_gate")
+
+        # Scalar wave wire (ring_scalar_wire): "packed" narrows every
+        # per-wave scalar payload to its information content — ok chains
+        # ride as 1 bit/node, buddy cols/vals as byte codes — and fuses
+        # each wave's scalars into ONE ops.roll_bundle call (a single
+        # ppermute payload on the sharded twin).  Validation pins packed
+        # to the fused period-scope rotor path, so the unfused branch
+        # below only ever sees "wide".
+        scalar_packed = cfg.ring_scalar_wire == "packed"
 
         def buddy_cv(d):
-            """Compact (col i32[N], val u32[N]): forced window bit of the
+            """Compact (col, val) per sender i: forced window bit of the
             suspect witness about subject (i + d) mod n, when sender i
             knows it and it is in the window (val 0 = inert).
             Subject-table lookups are rolls; the sender's own word is a
             streamed window column-select (window-only: val is masked by
-            in_win, so cold never matters)."""
+            in_win, so cold never matters).  Wide wire: (i32 col, u32
+            val).  Packed wire: (narrow col, u8 code = bit + 1, 0 =
+            inert) — the receiver rebuilds val as 1 << (code - 1), so
+            only ~2 bytes/node travel instead of 8."""
             if not (cfg.lifeguard and cfg.buddy):
                 return None
-            slot = roll_from(sus_slot, d)
+            if scalar_packed:
+                sdt = wavepack.code_dtype(r_tot)
+                slot = (roll_from((sus_slot + 1).astype(sdt), d,
+                                  label="roll_buddy_slots"
+                                  ).astype(jnp.int32) - 1)
+            else:
+                slot = roll_from(sus_slot, d, label="roll_buddy_slots")
             in_win, wcol, _, bit = slot_pos(slot)
             (wword,) = _col_select_multi(sel_win(), [wcol])
             kn = (slot >= 0) & (((wword >> bit) & 1) > 0)
             usebit = kn & in_win
+            if scalar_packed:
+                code = jnp.where(usebit, bit + 1,
+                                 jnp.uint32(0)).astype(jnp.uint8)
+                return wcol.astype(wavepack.code_dtype(g.ww - 1)), code
             return wcol, jnp.where(usebit, jnp.uint32(1) << bit,
                                    jnp.uint32(0))
 
@@ -1033,11 +1069,30 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                       == col[:, None])
             return jnp.where(onehot, val[:, None], jnp.uint32(0))
 
-        def wave_ok(send_flag_at_sender, d, u):
-            """bool[N] per receiver i: the message from (i+d) arrived."""
-            return (roll_from(send_flag_at_sender, d) & active
-                    & ~(part_on & (roll_from(pid, d) != pid))
-                    & (u >= loss_thr))
+        def wave_ok(send_flag_at_sender, d, u, cv=None):
+            """(ok bool[N], cv') per receiver i: the message from (i+d)
+            arrived.  The ok chain needs the sender's flag and partition
+            id at the receiver; on the packed wire those — plus the
+            wave's buddy (col, code), when given — fuse into ONE
+            roll_bundle payload, so cv' comes back receiver-aligned.
+            On the wide wire each vector rolls separately and cv passes
+            through sender-aligned (the fused staging rolls it)."""
+            if scalar_packed:
+                parts = (send_flag_at_sender, pid) + (cv or ())
+                labels = ("roll_ok_waves", "roll_pid_waves",
+                          "roll_buddy_cols", "roll_buddy_vals")
+                rolled = ops.roll_bundle(parts, d,
+                                         labels=labels[:len(parts)])
+                flag_r, pid_r = rolled[0], rolled[1]
+                cvr = tuple(rolled[2:]) if cv is not None else None
+            else:
+                flag_r = roll_from(send_flag_at_sender, d,
+                                   label="roll_ok_waves")
+                pid_r = roll_from(pid, d, label="roll_pid_waves")
+                cvr = cv
+            ok = (flag_r & active & ~(part_on & (pid_r != pid))
+                  & (u >= loss_thr))
+            return ok, cvr
 
         # Period scope: every wave ORs the SAME start-of-period selection
         # (sel_base | forced) into the window, and the ok chain never
@@ -1062,15 +1117,20 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                 waves.append((ok, d, cv))
             else:
                 sel_w = sel_now(force_mat(cv))
-                win = win | jnp.where(ok[:, None], roll_from(sel_w, d),
+                win = win | jnp.where(ok[:, None],
+                                      roll_from(sel_w, d,
+                                                label="roll_sel_waves"),
                                       jnp.uint32(0))
 
-        # W1: ping i -> i+s.  Receiver j hears from sender j−s.
-        ok1 = wave_ok(prober & active, -s_off, rnd.loss_w1)  # per recv j
-        deliver(ok1, -s_off, buddy_cv(s_off))
+        # W1: ping i -> i+s.  Receiver j hears from sender j−s.  The
+        # buddy payload shares W1's offset, so it rides W1's bundle on
+        # the packed wire.
+        ok1, cv1 = wave_ok(prober & active, -s_off, rnd.loss_w1,
+                           buddy_cv(s_off))                  # per recv j
+        deliver(ok1, -s_off, cv1)
         # W2: ack j=i+s -> i (acks iff the ping arrived; ok1 is indexed
         # by j already).  Receiver i hears from i+s.
-        ok2 = wave_ok(ok1, s_off, rnd.loss_w2)               # per recv i
+        ok2, _ = wave_ok(ok1, s_off, rnd.loss_w2)            # per recv i
         deliver(ok2, s_off)
         acked = ok2 & prober
 
@@ -1080,19 +1140,20 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             q = rnd.q_off[a]
             d4 = s_off - q
             # W3: ping-req i -> i+q.  Receiver p hears from p−q.
-            ok3 = wave_ok(need, -q, rnd.loss_w3[:, a])       # per recv p
+            ok3, _ = wave_ok(need, -q, rnd.loss_w3[:, a])    # per recv p
             deliver(ok3, -q)
             # W4: proxy ping p -> p+d4 (the original target j=i+s).
             # Receiver j hears from j−d4 = p.
-            ok4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a])       # per recv j
-            deliver(ok4, -d4, buddy_cv(d4))
+            ok4, cv4 = wave_ok(ok3, -d4, rnd.loss_w4[:, a],
+                               buddy_cv(d4))                 # per recv j
+            deliver(ok4, -d4, cv4)
             # W5: target ack j -> j−d4 (back to proxy p).  Receiver p
             # hears from p+d4.
-            ok5 = wave_ok(ok4, d4, rnd.loss_w5[:, a])        # per recv p
+            ok5, _ = wave_ok(ok4, d4, rnd.loss_w5[:, a])     # per recv p
             deliver(ok5, d4)
             # W6: relay ack p -> p−q (back to prober i).  Receiver i
             # hears from i+q.
-            ok6 = wave_ok(ok5, q, rnd.loss_w6[:, a])         # per recv i
+            ok6, _ = wave_ok(ok5, q, rnd.loss_w6[:, a])      # per recv i
             deliver(ok6, q)
             relayed = relayed | (ok6 & need)
 
@@ -1107,17 +1168,33 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                 return prof.captured
         if fused:
             # Buddy forced bits ride as receiver-aligned compact rows:
-            # roll the sender-side (col, val) by the wave's offset and
             # mask val by the wave's delivery (roll of sel|forced ==
-            # roll(sel) | roll(forced), bit-OR exact).
+            # roll(sel) | roll(forced), bit-OR exact).  Wide wire: roll
+            # the sender-side (col, val) here by the wave's offset.
+            # Packed wire: (col, code) already arrived receiver-aligned
+            # inside the wave's bundle — decode val = 1 << (code - 1)
+            # locally (where(ok, roll(val)) == where(ok & rolled-use,
+            # rolled 1<<bit), so the decode is bitwise-equal to the
+            # wide path).
             bcols, bvals = [], []
             for ok, d, cv in waves:
                 if cv is None:
                     continue
-                col, val = cv
-                bcols.append(roll_from(col, d))
-                bvals.append(jnp.where(ok, roll_from(val, d),
-                                       jnp.uint32(0)))
+                if scalar_packed:
+                    col_r, code_r = cv
+                    has = ok & (code_r > 0)
+                    shift = jnp.where(code_r > 0, code_r - 1,
+                                      0).astype(jnp.uint32)
+                    bcols.append(col_r.astype(jnp.int32))
+                    bvals.append(jnp.where(has, jnp.uint32(1) << shift,
+                                           jnp.uint32(0)))
+                else:
+                    col, val = cv
+                    bcols.append(roll_from(col, d,
+                                           label="roll_buddy_cols"))
+                    bvals.append(jnp.where(
+                        ok, roll_from(val, d, label="roll_buddy_vals"),
+                        jnp.uint32(0)))
             if prof is not None:
                 # end of "pack": wave payload staging (buddy compact
                 # rows rolled+masked; the sharded compact wire's B-slot
@@ -1159,7 +1236,18 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         # view_of(ids, target) + Phase C's self-suspicion word, fused:
         # subject tables roll (target is a rotation of ids), and all C+1
         # heard-word queries share ONE streamed pass over win and cold.
-        q_slots = [roll_from(top_slot[lvl], s_off) for lvl in range(g.c)]
+        if scalar_packed:
+            # slot + 1 in the narrowest dtype holding [0, r_tot]
+            # (0 = "no slot" stands in for -1), decoded after the roll.
+            sdt = wavepack.code_dtype(r_tot)
+            q_slots = [roll_from((top_slot[lvl] + 1).astype(sdt), s_off,
+                                 label="roll_view_slots"
+                                 ).astype(jnp.int32) - 1
+                       for lvl in range(g.c)]
+        else:
+            q_slots = [roll_from(top_slot[lvl], s_off,
+                                 label="roll_view_slots")
+                       for lvl in range(g.c)]
         q_slots.append(sus_slot)               # self query: subj == ids
         q_pos = [slot_pos(s) for s in q_slots]
         q_win = _col_select_multi(win, [p[1] for p in q_pos])
@@ -1177,13 +1265,21 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                                               q_slots):
             word = jnp.where(ok, wv, cv)
             q_kn.append((s >= 0) & (((word >> bit) & 1) > 0))
-        viewed_tk = jnp.maximum(lattice.alive_key(jnp.uint32(0)),
-                                roll_from(gone_key, s_off))
+        # Verdict deferral (both wires): instead of rolling gone_key and
+        # all C top keys to the viewer (C+1 u32 vectors), ship the C
+        # known-bits BACK to the subject (bool, 1 bit each on the packed
+        # wire), fold the key max at the subject, and roll the ONE u32
+        # verdict forward.  Rolls commute with elementwise max/where, so
+        # viewed_tk is bitwise-identical to the direct form.
+        kn_back = (ops.roll_bundle(tuple(q_kn[:g.c]), -s_off,
+                                   labels=("roll_view_known",) * g.c)
+                   if g.c else ())
+        tk_subj = jnp.maximum(lattice.alive_key(jnp.uint32(0)), gone_key)
         for lvl in range(g.c):
-            viewed_tk = jnp.maximum(
-                viewed_tk, jnp.where(q_kn[lvl],
-                                     roll_from(top_key[lvl], s_off),
-                                     jnp.uint32(0)))
+            tk_subj = jnp.maximum(
+                tk_subj, jnp.where(kn_back[lvl], top_key[lvl],
+                                   jnp.uint32(0)))
+        viewed_tk = roll_from(tk_subj, s_off, label="roll_view_verdict")
         self_key = jnp.where(q_kn[g.c], sus_bk, jnp.uint32(0))
         susp_subject = target
         susp_orig = ids

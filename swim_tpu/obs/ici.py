@@ -10,6 +10,17 @@ packed as slot indices, ops/wavepack.py: one [S, B] narrow-int block per
 wave plus one shared boundary fetch per period) — plus psum payloads for
 reductions/replicated gathers and the [D, kl] candidate all_gather.
 
+Scalar rolls tally under STABLE NAMED TERMS — the engine labels every
+node-vector roll at the call site (roll_probe_gate, roll_ok_waves,
+roll_pid_waves, roll_buddy_slots, roll_buddy_cols, roll_buddy_vals,
+roll_view_slots, roll_view_known, roll_view_verdict) — so artifacts
+compare across wire formats and dtype changes instead of keying on
+shapes.  The shape/dtype-derived `roll[...]` key survives only as the
+fallback for unlabeled rolls.  With `cfg.ring_scalar_wire == "packed"`
+the model charges bool vectors 1 bit/node (u32 word granularity) and
+narrow codes their byte width, matching ShardOps.roll_bundle's fused
+u8 payload byte-for-byte.
+
 The tally is static per (cfg, d): the wave schedule, payload shapes and
 collective set are compile-time constants, so the per-period byte cost
 does not vary at runtime.  The flight recorder embeds it in the dump
@@ -46,10 +57,35 @@ def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS) -> dict:
             self.cfg = cfg
             self.d = d
 
-        def roll_from(self, x, dd):
-            add(f"roll[{'x'.join(map(str, x.shape))},{x.dtype}]",
-                2 * x.size * x.dtype.itemsize // self.d)
+        def _roll_part_bytes(self, x):
+            """Bytes ONE neighbor-block transfer of x costs per chip:
+            rows-per-shard lanes at the wire dtype — except a bool node
+            vector on the packed scalar wire, which ships 1 bit/node
+            (u32 words, ops/wavepack.py pack_bits)."""
+            s = x.shape[0] // self.d
+            if (self.cfg.ring_scalar_wire == "packed" and x.ndim == 1
+                    and x.dtype == jnp.bool_):
+                return 4 * wavepack.packed_words(s)
+            return s * (x.size // x.shape[0]) * x.dtype.itemsize
+
+        def _roll_key(self, x, label):
+            return (label if label is not None else
+                    f"roll[{'x'.join(map(str, x.shape))},{x.dtype}]")
+
+        def roll_from(self, x, dd, label=None):
+            add(self._roll_key(x, label), 2 * self._roll_part_bytes(x))
             return super().roll_from(x, dd)
+
+        def roll_bundle(self, parts, dd, labels=None):
+            # The packed wire fuses all parts into one ppermute pair,
+            # but the per-part packed bytes sum exactly to the fused
+            # payload (pack_bundle concatenates byte views), so the
+            # tally stays per named term with no fusion residue.
+            if labels is None:
+                labels = [None] * len(parts)
+            for x, lb in zip(parts, labels):
+                add(self._roll_key(x, lb), 2 * self._roll_part_bytes(x))
+            return super().roll_bundle(parts, dd, labels)
 
         def merge_waves(self, win, sel, oks, offs, bcols, bvals, impl):
             if self.cfg.ring_ici_wire == "compact":

@@ -255,18 +255,33 @@ def phase_hbm_model(cfg) -> dict[str, tuple[float, float]]:
 
 def phase_ici_model(cfg, d: int = 8) -> dict[str, int]:
     """Modeled per-chip ICI bytes per phase for a `d`-chip sharding,
-    from obs/ici.py's per-collective tally (collective -> phase)."""
+    from obs/ici.py's per-collective tally (named term -> phase, per
+    the fused path's cut order — see phases_for)."""
     from swim_tpu.obs.ici import trace_ici_bytes
 
     active = phases_for(cfg)
     out = {p: 0 for p in active}
+    # Buddy (col, val) travel with the ok-chain bundle on the packed
+    # scalar wire but roll during fused payload staging on the wide one.
+    buddy = ("ppermute" if cfg.ring_scalar_wire == "packed" else "pack")
+    roll_phase = {
+        "roll_probe_gate": "ppermute", "roll_ok_waves": "ppermute",
+        "roll_pid_waves": "ppermute", "roll_buddy_slots": "ppermute",
+        "roll_buddy_cols": buddy, "roll_buddy_vals": buddy,
+        "roll_view_slots": "commit", "roll_view_known": "commit",
+        "roll_view_verdict": "commit",
+    }
     for key, nbytes in trace_ici_bytes(cfg, d)["breakdown"].items():
         if key == "sel_wire_boundary" or key.startswith("roll_sel_waves"):
             p = "merge"
+        elif key in roll_phase:
+            p = roll_phase[key]
         elif key.startswith("roll["):
-            p = "ppermute" if "ppermute" in active else "merge"
+            p = "ppermute"
         else:   # psum_scalar / gather_psum / knows_psum / candidates_*
             p = "commit"
+        if p not in out:   # coarse phase set: wave terms fold into merge
+            p = "merge"
         out[p] = out.get(p, 0) + int(nbytes)
     return out
 

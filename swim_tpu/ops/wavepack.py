@@ -33,6 +33,26 @@ packed payload is b * itemsize bytes/row — 24 -> 6 at the lean
 geometry (WW=6, b=6, uint8) and 48 -> 12 at the default (WW=12, b=6,
 uint16), per neighbor-block transfer.  scripts/shard_anchor.py tallies
 the resulting per-chip ICI bytes for both wire formats.
+
+Scalar wave wire (ring_scalar_wire="packed") — the same pack-once
+discipline applied to the per-wave SCALAR payloads (PR after the sel
+window):
+
+  * `pack_bits` / `unpack_bits`: a bool node vector rides as 1 bit per
+    node (u32 words, SWIM's delivery flags are single bits — Das et
+    al., DSN 2002 §4.1), 32x narrower than the bool8 lanes XLA would
+    ship and 128x narrower than the int32 lanes the flags historically
+    widened to.
+  * `code_dtype`: the narrowest unsigned dtype holding a bounded code
+    (slot + 1 sentinel encodings, buddy window columns) — the same
+    sizing rule as slot_dtype, keyed by the value bound instead of the
+    window geometry.
+  * `pack_bundle` / `unpack_bundle`: several same-offset node vectors
+    (a wave's ok chain + partition ids + buddy col/val codes) fuse
+    into ONE u8 payload per neighbor block, so the sharded twin pays a
+    single ppermute pair per wave no matter how many arrays ride.
+    Bools bit-pack first; narrow ints bitcast to bytes.  Round-trip is
+    bitwise exact, so the packed wire inherits the parity contract.
 """
 
 from __future__ import annotations
@@ -57,6 +77,82 @@ def slot_dtype(ww: int):
 def packed_itemsize(ww: int) -> int:
     """Bytes per packed slot entry — the anchor model's tally unit."""
     return jnp.dtype(slot_dtype(ww)).itemsize
+
+
+def code_dtype(max_code: int):
+    """Narrowest unsigned dtype that can hold values in [0, max_code]."""
+    if max_code <= 255:
+        return jnp.uint8
+    if max_code <= 65535:
+        return jnp.uint16
+    return jnp.uint32
+
+
+def packed_words(s: int) -> int:
+    """u32 words a bit-packed bool[s] occupies."""
+    return -(-s // WORD)
+
+
+def pack_bits(flags: jax.Array) -> jax.Array:
+    """bool[s] -> u32[ceil(s/32)], bit i of word w = flags[32*w + i]."""
+    s = flags.shape[0]
+    w = packed_words(s)
+    padded = jnp.concatenate(
+        [flags, jnp.zeros((w * WORD - s,), jnp.bool_)]).reshape(w, WORD)
+    weights = jnp.uint32(1) << jnp.arange(WORD, dtype=jnp.uint32)
+    return jnp.sum(jnp.where(padded, weights[None, :], jnp.uint32(0)),
+                   axis=1)
+
+
+def unpack_bits(words: jax.Array, s: int) -> jax.Array:
+    """Inverse of pack_bits: u32[ceil(s/32)] -> bool[s]."""
+    bit = jnp.arange(WORD, dtype=jnp.uint32)[None, :]
+    bits = ((words[:, None] >> bit) & jnp.uint32(1)) > 0
+    return bits.reshape(-1)[:s]
+
+
+def _byte_view(x: jax.Array) -> jax.Array:
+    """Flat u8 view of a 1-D array (bools bit-pack first)."""
+    if x.dtype == jnp.bool_:
+        x = pack_bits(x)
+    if x.dtype == jnp.uint8:
+        return x
+    return jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(-1)
+
+
+def bundle_nbytes(x: jax.Array) -> int:
+    """Bytes one part contributes to a packed bundle payload."""
+    if x.dtype == jnp.bool_:
+        return 4 * packed_words(x.shape[0])
+    return x.shape[0] * jnp.dtype(x.dtype).itemsize
+
+
+def pack_bundle(parts) -> jax.Array:
+    """Fuse same-length 1-D node vectors into ONE u8 payload: bools
+    bit-pack to u32 words, narrow ints bitcast — a single wire array
+    per neighbor block for the whole wave."""
+    return jnp.concatenate([_byte_view(x) for x in parts])
+
+
+def unpack_bundle(payload: jax.Array, like) -> list[jax.Array]:
+    """Split a pack_bundle payload back into parts shaped/typed like
+    the reference arrays `like` (bitwise inverse of pack_bundle)."""
+    outs, off = [], 0
+    for x in like:
+        nb = bundle_nbytes(x)
+        seg = payload[off:off + nb]
+        off += nb
+        if x.dtype == jnp.bool_:
+            words = jax.lax.bitcast_convert_type(
+                seg.reshape(-1, 4), jnp.uint32)
+            outs.append(unpack_bits(words, x.shape[0]))
+        elif x.dtype == jnp.uint8:
+            outs.append(seg)
+        else:
+            itemsize = jnp.dtype(x.dtype).itemsize
+            outs.append(jax.lax.bitcast_convert_type(
+                seg.reshape(-1, itemsize), x.dtype))
+    return outs
 
 
 def pack_slots(sel: jax.Array, b: int) -> jax.Array:

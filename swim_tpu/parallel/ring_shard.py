@@ -24,6 +24,13 @@ movement (SURVEY.md §5 "Distributed comm backend"):
     boundary block is prefetched, and each wave then moves ONE packed
     [S, B] narrow-int block — ~WW*32/B fewer ICI bytes per wave,
     bitwise-equal after receiver-side unpack (see merge_waves).
+  * **Scalar wave payloads → one bit-packed bundle (optional).**  With
+    `cfg.ring_scalar_wire == "packed"` each wave's SCALAR vectors — ok
+    chain (bool), partition ids (u8), buddy col/val codes — fuse into
+    ONE u8 ppermute payload per neighbor block (ops/wavepack.py
+    pack_bundle: bools ride as 1 bit/node), and lone bool rolls
+    bit-pack too (see roll_bundle / roll_from).  Bitwise-equal after
+    receiver-side unpack.
   * **Global reductions → psum** of per-shard partials (all integer —
     bitwise-exact, no float reassociation concerns).
   * **Node-axis scatter/gather by global id → masked local ops.**  Each
@@ -109,6 +116,7 @@ class ShardOps:
         self.s = self.n // n_shards
         self.lo = jax.lax.axis_index(AXIS).astype(jnp.int32) * self.s
         self.wire = cfg.ring_ici_wire
+        self.scalar_wire = cfg.ring_scalar_wire
         g = ring.geometry(cfg)
         self.ww = g.ww
         self.b_pig = min(cfg.max_piggyback, g.ww * ring.WORD)
@@ -139,11 +147,17 @@ class ShardOps:
         perm = [(p, (p - k_static) % self.d) for p in range(self.d)]
         return jax.lax.ppermute(x, AXIS, perm)
 
-    def roll_from(self, x, d):
+    def roll_from(self, x, d, label=None):
         """x at global node (i + d) mod n for my rows i: d = k·S + r, so
         the answer is rows [r, S) of shard me+k plus rows [0, r) of
         shard me+k+1 — two ppermutes (switch-selected static k) and one
-        dynamic slice.
+        dynamic slice.  `label` names the roll for the ICI byte tally
+        (obs/ici.py CountingOps); inert on the real wire.
+
+        With cfg.ring_scalar_wire == "packed", a lone bool node vector
+        still ships bit-packed: it delegates to roll_bundle, whose
+        payload for one bool part is u32[ceil(S/32)] bitcast to bytes —
+        32x narrower than the bool lanes the wide wire moves.
 
         INVARIANT: `d` must be REPLICATED across shards (identical traced
         value on every shard). The lax.switch selects which ppermute
@@ -154,6 +168,10 @@ class ShardOps:
         `d` from `rnd.*` fields, which place() replicates by construction.
         Set DEBUG_REPLICATED=True to audit the invariant at runtime (the
         printed spread must be 0 on every call)."""
+        del label
+        if (self.scalar_wire == "packed" and x.ndim == 1
+                and x.dtype == jnp.bool_):
+            return self.roll_bundle((x,), d)[0]
         dd = jnp.mod(jnp.asarray(d, jnp.int32), self.n)
         if DEBUG_REPLICATED:
             spread = (jax.lax.pmax(dd, AXIS) - jax.lax.pmin(dd, AXIS))
@@ -167,6 +185,49 @@ class ShardOps:
         b = self._rot(a, 1)
         ab = jnp.concatenate([a, b], axis=0)
         return jax.lax.dynamic_slice_in_dim(ab, r, self.s, axis=0)
+
+    def roll_bundle(self, parts, d, labels=None):
+        """roll_from over several same-offset node vectors at once —
+        the packed scalar wire's fusion seam (cfg.ring_scalar_wire).
+
+        "wide": each part rolls on its own (two dtype-wide neighbor
+        blocks per part, exactly the historical wire).
+
+        "packed": the parts fuse into ONE u8 payload per neighbor block
+        (ops/wavepack.py pack_bundle — bools bit-pack to u32 words,
+        narrow ints bitcast to bytes), so the whole wave costs one
+        ppermute pair of sum-of-packed-bytes lanes no matter how many
+        vectors ride.  Packing wraps only the ppermute leg: both
+        neighbor blocks unpack back to typed [S] vectors BEFORE the
+        r-offset stitch, so the dynamic slice works at row granularity
+        and never splits a bit-packed word across the block boundary.
+        Bitwise-exact round-trip (tests/test_wavepack.py), so the parity
+        contract is inherited unchanged.
+
+        The same replicated-shift invariant as roll_from applies."""
+        del labels
+        if not parts:
+            return ()
+        if self.scalar_wire != "packed":
+            return tuple(self.roll_from(x, d) for x in parts)
+        dd = jnp.mod(jnp.asarray(d, jnp.int32), self.n)
+        if DEBUG_REPLICATED:
+            spread = (jax.lax.pmax(dd, AXIS) - jax.lax.pmin(dd, AXIS))
+            jax.debug.print("roll_bundle shift spread (must be 0): {s}",
+                            s=spread)
+        k = dd // self.s
+        r = jnp.mod(dd, self.s)
+        payload = wavepack.pack_bundle(parts)
+        a = jax.lax.switch(
+            k, [functools.partial(self._rot, k_static=kk)
+                for kk in range(self.d)], payload)
+        b = self._rot(a, 1)
+        pa = wavepack.unpack_bundle(a, parts)
+        pb = wavepack.unpack_bundle(b, parts)
+        return tuple(
+            jax.lax.dynamic_slice_in_dim(
+                jnp.concatenate([xa, xb], axis=0), r, self.s, axis=0)
+            for xa, xb in zip(pa, pb))
 
     # -- node-axis scatter/gather by GLOBAL node id -----------------------
     def _local(self, idx):

@@ -9,7 +9,12 @@ simulator instead expresses the whole fault schedule as data:
     per directed message (every message wave draws its own uniforms).
   * partition: `partition_id[N]` group labels; between `partition_start` and
     `partition_end` (half-open, in periods) messages between different
-    groups are dropped.
+    groups are dropped.  Labels are uint8 (up to 256 groups): every
+    consumer compares them for EQUALITY only, and the ring engine rolls
+    the label vector once per message wave — at 1M nodes over 8 chips
+    the historical int32 labels were the single largest scalar ICI term
+    (6 MB/period/chip at the lean geometry), paying 4x for width no
+    comparison ever used.
 
 Everything here is a *runtime* value — sweeps over loss rates, crash
 schedules, or partition windows reuse a single compiled step (the engines
@@ -30,7 +35,8 @@ NEVER = np.int32(2**31 - 1)
 class FaultPlan(NamedTuple):
     crash_step: jax.Array       # i32[N], NEVER = no crash
     loss: jax.Array             # f32 scalar in [0, 1)
-    partition_id: jax.Array     # i32[N] group labels
+    partition_id: jax.Array     # u8[N] group labels (equality-only; 256
+    #                              groups max — with_partition validates)
     partition_start: jax.Array  # i32 scalar (period, inclusive)
     partition_end: jax.Array    # i32 scalar (period, exclusive)
     join_step: jax.Array        # i32[N], period a node becomes a member
@@ -52,7 +58,7 @@ def none(n: int) -> FaultPlan:
     return FaultPlan(
         crash_step=jnp.full((n,), NEVER, jnp.int32),
         loss=jnp.float32(0.0),
-        partition_id=jnp.zeros((n,), jnp.int32),
+        partition_id=jnp.zeros((n,), jnp.uint8),
         partition_start=jnp.int32(0),
         partition_end=jnp.int32(0),
         join_step=jnp.zeros((n,), jnp.int32),
@@ -100,11 +106,17 @@ def with_partition(plan: FaultPlan, group_of, start: int,
                    end: int) -> FaultPlan:
     """Two-or-more-way partition over [start, end) periods.
 
-    `group_of` is an i32[N] label array (e.g. halves for the 2-way split of
-    BASELINE.md config 3).
+    `group_of` is a label array (e.g. halves for the 2-way split of
+    BASELINE.md config 3); labels must fit uint8 (up to 256 groups —
+    the wire dtype the engines roll per message wave).
     """
+    group = np.asarray(group_of)
+    if group.size and (group.min() < 0 or group.max() > 255):
+        raise ValueError(
+            f"partition labels must be in [0, 255] (uint8 wire dtype): "
+            f"got range [{group.min()}, {group.max()}]")
     return plan._replace(
-        partition_id=jnp.asarray(group_of, jnp.int32),
+        partition_id=jnp.asarray(group, jnp.uint8),
         partition_start=jnp.int32(start),
         partition_end=jnp.int32(end),
     )
@@ -112,7 +124,7 @@ def with_partition(plan: FaultPlan, group_of, start: int,
 
 def halves(n: int) -> np.ndarray:
     """Label array for a 2-way even split."""
-    g = np.zeros((n,), np.int32)
+    g = np.zeros((n,), np.uint8)
     g[n // 2:] = 1
     return g
 

@@ -236,10 +236,11 @@ class TestHeadlinePromotion:
 
 class TestShardAnchorSmoke:
     """The anchor model can never again land unexecuted (VERDICT r5):
-    --cpu-smoke traces the full-size per-chip ICI byte tallies for BOTH
-    wire formats in seconds, and the compact wire must hold its >= 8x
-    roll_sel_waves cut at the lean 1M/8-chip arm — the acceptance
-    number of the compact-wire PR."""
+    --cpu-smoke traces the full-size per-chip ICI byte tallies for all
+    four (sel wire x scalar wire) combos in seconds; the compact wire
+    must hold its >= 8x roll_sel_waves cut and the packed scalar wire
+    its >= 3x scalar-roll cut at the lean 1M/8-chip arm — the
+    acceptance numbers of the compact-wire and packed-scalar PRs."""
 
     @pytest.fixture(scope="class")
     def smoke(self):
@@ -261,7 +262,8 @@ class TestShardAnchorSmoke:
 
     def test_both_wire_tallies_present_per_arm(self, smoke):
         for name, arm in smoke["arms"].items():
-            for wire in ("window", "compact"):
+            for wire in ("window", "compact", "window+packed",
+                         "compact+packed"):
                 bd = arm["wires"][wire]["ici_traced"]["breakdown"]
                 assert bd.get("roll_sel_waves", 0) > 0, (name, wire, bd)
             assert "sel_wire_boundary" in \
@@ -279,6 +281,91 @@ class TestShardAnchorSmoke:
         hardware) and no artifact write from smoke mode."""
         assert all(a["chip_measured"] is None
                    for a in smoke["arms"].values())
+
+    def test_named_scalar_terms_partition_the_tally(self, smoke):
+        """Every scalar roll tallies under a stable NAMED term — no
+        shape/dtype-derived roll[...] key survives on either scalar-wire
+        arm — and the named terms plus the non-roll collectives sum
+        exactly to per_chip_bytes_per_period (nothing uncounted, nothing
+        double-counted)."""
+        named = {"roll_probe_gate", "roll_ok_waves", "roll_pid_waves",
+                 "roll_buddy_slots", "roll_buddy_cols", "roll_buddy_vals",
+                 "roll_view_slots", "roll_view_known",
+                 "roll_view_verdict", "roll_sel_waves"}
+        for name, arm in smoke["arms"].items():
+            for wire, w in arm["wires"].items():
+                t = w["ici_traced"]
+                bd = t["breakdown"]
+                generic = [k for k in bd if k.startswith("roll[")]
+                assert not generic, (name, wire, generic)
+                rolls = {k for k in bd if k.startswith("roll")}
+                assert rolls <= named, (name, wire, rolls - named)
+                assert sum(bd.values()) == t["per_chip_bytes_per_period"]
+
+    def test_packed_scalar_wire_meets_acceptance(self, smoke):
+        """The packed-scalar-wire PR's acceptance numbers at the lean
+        1M/8-chip arm: combined scalar roll bytes cut >= 3x vs the
+        pre-PR artifact (12.75 MB -> <= 4.25 MB), total ICI <= 10
+        MB/period/chip on the compact+packed wire, and the resulting
+        chip-independent ICI ceiling >= 4,500 p/s."""
+        lean = smoke["arms"]["lean"]
+        assert lean["scalar_roll_reduction_vs_pre_pr"] >= 3.0
+        cp = lean["wires"]["compact+packed"]
+        assert cp["scalar_roll_bytes"] <= 4_250_000
+        t = cp["ici_traced"]
+        assert t["per_chip_bytes_per_period"] <= 10_000_000
+        assert t["ici_ceiling_pps"] >= 4_500
+        # the packed bundles must also never cost MORE than wide lanes,
+        # sel wire held fixed, on either arm
+        for arm in smoke["arms"].values():
+            for wire in ("window", "compact"):
+                assert (arm["wires"][wire + "+packed"]["scalar_roll_bytes"]
+                        < arm["wires"][wire]["scalar_roll_bytes"])
+
+
+class TestScalarWireTrace:
+    """Direct trace_ici_bytes pins that need knobs the anchor arms keep
+    off (lifeguard+buddy for the buddy terms) — in-process, tiny cfg."""
+
+    def test_buddy_terms_named_on_both_scalar_wires(self):
+        from swim_tpu import SwimConfig
+        from swim_tpu.obs.ici import trace_ici_bytes
+
+        base = dict(n_nodes=4096, ring_sel_scope="period",
+                    lifeguard=True, k_indirect=1, max_piggyback=2,
+                    ring_window_periods=2, ring_view_c=2)
+        for scalar in ("wide", "packed"):
+            cfg = SwimConfig(**base, ring_scalar_wire=scalar)
+            bd = trace_ici_bytes(cfg, 8)["breakdown"]
+            for term in ("roll_buddy_slots", "roll_buddy_cols",
+                         "roll_buddy_vals", "roll_ok_waves",
+                         "roll_pid_waves", "roll_view_slots",
+                         "roll_view_known", "roll_view_verdict",
+                         "roll_probe_gate"):
+                assert bd.get(term, 0) > 0, (scalar, term, bd)
+            assert not [k for k in bd if k.startswith("roll[")], bd
+
+    def test_packed_bool_charged_one_bit_per_node(self):
+        """The packed model must charge bool rolls at the bit-packed
+        wire size: 2 blocks x 4 bytes x ceil((n/d)/32) words."""
+        from swim_tpu import SwimConfig
+        from swim_tpu.obs.ici import trace_ici_bytes
+
+        base = dict(n_nodes=4096, ring_sel_scope="period", k_indirect=1,
+                    max_piggyback=2, ring_window_periods=2,
+                    ring_view_c=2)
+        wide = trace_ici_bytes(
+            SwimConfig(**base, ring_scalar_wire="wide"), 8)["breakdown"]
+        packed = trace_ici_bytes(
+            SwimConfig(**base, ring_scalar_wire="packed"),
+            8)["breakdown"]
+        s = 4096 // 8
+        waves = 2 + 4 * 1
+        assert wide["roll_ok_waves"] == waves * 2 * s          # bool lanes
+        assert packed["roll_ok_waves"] == waves * 2 * 4 * -(-s // 32)
+        # pid is u8 at source now: same cost on both scalar wires
+        assert wide["roll_pid_waves"] == waves * 2 * s
+        assert packed["roll_pid_waves"] == wide["roll_pid_waves"]
 
 
 class TestWatcherCaptureChecks:

@@ -94,10 +94,12 @@ class TestPhaseByteModels:
             assert sum(u for _, u in model.values()) == \
                 pytest.approx(sum(u for _, u in tr["terms"].values()))
 
-    def test_ici_model_partitions_collective_tally(self):
+    @pytest.mark.parametrize("scalar_wire", ["wide", "packed"])
+    def test_ici_model_partitions_collective_tally(self, scalar_wire):
         from swim_tpu.obs.ici import trace_ici_bytes
 
-        cfg = SwimConfig(n_nodes=256, ring_sel_scope="period", **SMALL)
+        cfg = SwimConfig(n_nodes=256, ring_sel_scope="period",
+                         ring_scalar_wire=scalar_wire, **SMALL)
         tally = trace_ici_bytes(cfg, 8)
         model = prof.phase_ici_model(cfg, 8)
         assert set(model) <= set(prof.phases_for(cfg))

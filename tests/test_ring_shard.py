@@ -40,6 +40,7 @@ def run_both(cfg, plan, periods, seed=7, shard_cfgs=()):
     for c in (cfg, *shard_cfgs):
         st, pl = ring_shard.place(c, mesh, ring.init_state(c), plan)
         label = (c.ring_ici_wire
+                 + ("+packed" if c.ring_scalar_wire == "packed" else "")
                  + ("+telemetry" if c.telemetry else "")
                  + ("+profiling" if c.profiling else ""))
         arms.append({"label": label, "state": st, "plan": pl,
@@ -110,30 +111,51 @@ class TestBitwiseVsGlobal:
         run_both(cfg, plan, 16, seed=9)
 
     def test_period_sel_buddy_and_compact_wire(self):
-        """Two pins in one tri-run (ADVICE r5 + the compact-wire
-        tentpole): (a) lifeguard at period scope drives ShardOps.
-        merge_waves' bcols/bvals buddy OR path — previously untested
-        sharded — and (b) ring_ici_wire='compact' (packed slot-index
-        wave payloads, ops/wavepack.py) must match BOTH the dense-wire
-        shard and the single-program engine bitwise, with buddy forced
-        bits live."""
+        """The full wire matrix in one run (ADVICE r5 + the compact-wire
+        and packed-scalar tentpoles): (a) lifeguard at period scope
+        drives ShardOps.merge_waves' bcols/bvals buddy OR path, (b)
+        ring_ici_wire='compact' (packed slot-index wave payloads,
+        ops/wavepack.py), and (c) ring_scalar_wire='packed' (bit-packed
+        ok chains + narrow buddy codes fused into one roll_bundle
+        ppermute payload per wave) — all 2x2 (sel wire x scalar wire)
+        shard arms must match the single-program engine bitwise, with
+        buddy forced bits live on every arm."""
         n = 64
         cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
                          lifeguard=True, **SMALL_GEOM)
         plan = faults.with_loss(
             faults.with_crashes(faults.none(n), [5, 40], [2, 6]), 0.1)
         run_both(cfg, plan, 16, seed=9,
-                 shard_cfgs=(cfg.replace(ring_ici_wire="compact"),))
+                 shard_cfgs=(cfg.replace(ring_ici_wire="compact"),
+                             cfg.replace(ring_scalar_wire="packed"),
+                             cfg.replace(ring_ici_wire="compact",
+                                         ring_scalar_wire="packed")))
 
     def test_compact_wire_partition_and_join(self):
         """Compact wire under partition + late join (vanilla protocol):
         the slot-index wire stays bitwise against the global engine when
         the heard-set churns hard.  (Direct compact-vs-dense-wire parity
-        at identical cfg is pinned by the tri-run test above; running
+        at identical cfg is pinned by the wire-matrix test above; running
         the compact arm alone here saves one sharded compile.)"""
         n = 64
         cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
                          ring_ici_wire="compact", **SMALL_GEOM)
+        plan = faults.with_partition(faults.none(n), [1] * 16 + [0] * 48,
+                                     3, 9)
+        plan = plan._replace(join_step=plan.join_step.at[21].set(4))
+        run_both(cfg, plan, 12, seed=17)
+
+    def test_packed_scalar_wire_partition_and_join(self):
+        """Packed scalar wire under partition + late join: the u8
+        partition ids, bit-packed ok chains and deferred view verdicts
+        ride the fused bundles while cross-group drops and a join churn
+        the ok chain hard — bitwise against the global engine.  (The
+        partition masking is exactly what the pid lanes exist for, so
+        this is the packed wire's adversarial case.)"""
+        n = 64
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         ring_ici_wire="compact",
+                         ring_scalar_wire="packed", **SMALL_GEOM)
         plan = faults.with_partition(faults.none(n), [1] * 16 + [0] * 48,
                                      3, 9)
         plan = plan._replace(join_step=plan.join_step.at[21].set(4))
@@ -288,3 +310,34 @@ class TestCommunicationPattern:
             counts = [int(np.prod([int(d) for d in m.group(1).split(",")]))
                       for m in re.finditer(r"\w+\[([\d,]+)\]", line)]
             assert max(counts, default=1) <= 2048, line[:120]
+
+    def test_packed_scalar_wire_moves_packed_words(self):
+        """With ring_scalar_wire='packed' the scalar wave exchanges must
+        ship fused u8 bundle payloads, and NO [S]-shaped int32 or bool
+        node vector may cross ICI: at n=4096/D=8 (S=512) the HLO's
+        collective-permutes carry no s32[512] (the historical partition-
+        id lanes) and no pred[512] (the historical ok-flag lanes — they
+        ride as 1 bit/node inside the u8 bundles).  The one u32[512]
+        survivor is the deferred view verdict, by design."""
+        n = 4096
+        cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
+                         ring_ici_wire="compact",
+                         ring_scalar_wire="packed", **SMALL_GEOM)
+        mesh = pmesh.make_mesh(8)
+        plan = faults.with_crashes(faults.none(n), [5], [2])
+        s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
+                                           plan)
+        rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
+        step = ring_shard.build_step(cfg, mesh)
+        txt = step.lower(s_state, s_plan, rnd).compile().as_text()
+
+        # only TRUE collective-permute instructions (sync or async
+        # start), not downstream fusions that consume a permute result
+        cperms = [l for l in txt.splitlines()
+                  if re.search(r"collective-permute(-start)?\(", l)]
+        assert cperms, "wave rolls must use ppermute"
+        assert any("u8[" in l for l in cperms), \
+            "no packed (u8) collective-permute payload found"
+        wide = [l.strip()[:120] for l in cperms
+                if re.search(r"(s32|pred)\[512\]", l)]
+        assert not wide, f"dtype-wide scalar lanes still on ICI: {wide}"

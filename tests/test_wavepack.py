@@ -74,3 +74,72 @@ class TestDtypeChoice:
     def test_itemsize_matches_anchor_tally_unit(self):
         assert wavepack.packed_itemsize(6) == 1
         assert wavepack.packed_itemsize(12) == 2
+
+    def test_code_dtype_boundaries(self):
+        assert wavepack.code_dtype(0) == jnp.uint8
+        assert wavepack.code_dtype(255) == jnp.uint8
+        assert wavepack.code_dtype(256) == jnp.uint16
+        assert wavepack.code_dtype(65535) == jnp.uint16
+        assert wavepack.code_dtype(65536) == jnp.uint32
+
+
+class TestBitPack:
+    """The scalar wire's bool codec: 1 bit/node in u32 words."""
+
+    @pytest.mark.parametrize("s", [1, 31, 32, 33, 513, 1000])
+    def test_roundtrip(self, s):
+        rng = np.random.default_rng(s)
+        flags = rng.random(s) < 0.3
+        words = wavepack.pack_bits(jnp.asarray(flags))
+        assert words.dtype == jnp.uint32
+        assert words.shape == (wavepack.packed_words(s),)
+        np.testing.assert_array_equal(
+            np.asarray(wavepack.unpack_bits(words, s)), flags)
+
+    def test_bit_layout(self):
+        """Bit i of word w is flags[32*w + i] — the documented layout
+        (pack/unpack must agree across implementations)."""
+        flags = np.zeros(64, bool)
+        flags[0] = flags[33] = True
+        words = np.asarray(wavepack.pack_bits(jnp.asarray(flags)))
+        assert words[0] == 1 and words[1] == 2
+
+    def test_packed_words(self):
+        assert wavepack.packed_words(1) == 1
+        assert wavepack.packed_words(32) == 1
+        assert wavepack.packed_words(33) == 2
+
+
+class TestBundle:
+    """pack_bundle/unpack_bundle: one u8 payload for a wave's scalars."""
+
+    def test_roundtrip_mixed_dtypes(self):
+        rng = np.random.default_rng(7)
+        s = 257
+        parts = (
+            jnp.asarray(rng.random(s) < 0.5),                    # bool
+            jnp.asarray(rng.integers(0, 256, s), jnp.uint8),     # u8
+            jnp.asarray(rng.integers(0, 65536, s), jnp.uint16),  # u16
+            jnp.asarray(rng.integers(0, 2**32, s), jnp.uint32),  # u32
+            jnp.asarray(rng.random(s) < 0.1),                    # bool again
+        )
+        payload = wavepack.pack_bundle(parts)
+        assert payload.dtype == jnp.uint8
+        assert payload.shape == (
+            sum(wavepack.bundle_nbytes(x) for x in parts),)
+        outs = wavepack.unpack_bundle(payload, parts)
+        for x, y in zip(parts, outs):
+            assert y.dtype == x.dtype and y.shape == x.shape
+            np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+    def test_bundle_nbytes(self):
+        assert wavepack.bundle_nbytes(jnp.zeros((33,), jnp.bool_)) == 8
+        assert wavepack.bundle_nbytes(jnp.zeros((33,), jnp.uint8)) == 33
+        assert wavepack.bundle_nbytes(jnp.zeros((33,), jnp.uint16)) == 66
+
+    def test_single_bool_part(self):
+        """The lone-bool delegation path in ShardOps.roll_from."""
+        flags = jnp.asarray(np.random.default_rng(1).random(100) < 0.5)
+        payload = wavepack.pack_bundle((flags,))
+        (out,) = wavepack.unpack_bundle(payload, (flags,))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(flags))

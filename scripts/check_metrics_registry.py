@@ -21,6 +21,13 @@ PROF_GAUGES and vice versa (AST source scan, mirroring the stats-key
 lint — render_profile's own runtime assert only fires when a profile
 artifact actually renders, which CI without an artifact never does).
 
+And the scenario-rule surface: the two fault-schedule-aware health
+rules the scenario compiler feeds (gray_undetected, flap_false_dead)
+must exist in HEALTH_RULES, and every rule a library scenario names —
+`allow_rules` waivers, `rule_fired` expectations — must be a declared
+rule, so a rule rename can never silently void a waiver or doom an
+expectation.
+
 Run directly (`python scripts/check_metrics_registry.py`) or via the
 fast tier-1 test that shells out to it (tests/test_telemetry.py).
 """
@@ -130,6 +137,42 @@ def check_prof_gauges() -> list[str]:
     return problems
 
 
+def check_scenario_rules() -> list[str]:
+    """Problems with the scenario/health-rule surface ([] = clean).
+
+    The scenario compiler leans on two fault-schedule-aware health
+    rules (gray_undetected, flap_false_dead) and lets library specs
+    name rules in `allow_rules` waivers and `rule_fired` expectations —
+    a renamed or deleted rule would silently turn a waiver into a no-op
+    and a rule_fired check into a guaranteed failure, so pin the whole
+    rule vocabulary here at build time.
+    """
+    from swim_tpu.obs.health import HEALTH_RULES
+
+    problems: list[str] = []
+    for rule in ("gray_undetected", "flap_false_dead"):
+        if rule not in HEALTH_RULES:
+            problems.append(
+                f"scenario rule {rule!r} missing from HEALTH_RULES — "
+                "the scenario gauges (sim/scenario.py fault_gauges) "
+                "feed it")
+    from swim_tpu.sim import scenario
+
+    for name, spec in scenario.LIBRARY.items():
+        unknown = sorted(set(spec.allow_rules) - set(HEALTH_RULES))
+        if unknown:
+            problems.append(
+                f"library scenario {name!r} waives unknown rule(s) "
+                f"{unknown} — waivers must name HEALTH_RULES entries")
+        for chk in spec.expect:
+            if chk.get("check") == "rule_fired" \
+                    and chk.get("rule") not in HEALTH_RULES:
+                problems.append(
+                    f"library scenario {name!r} expects unknown rule "
+                    f"{chk.get('rule')!r} to fire")
+    return problems
+
+
 def main() -> int:
     from swim_tpu.obs.registry import NODE_COUNTERS
 
@@ -159,13 +202,19 @@ def main() -> int:
     for problem in prof_problems:
         ok = False
         print(f"prof-gauge lint: {problem}", file=sys.stderr)
+    scenario_problems = check_scenario_rules()
+    for problem in scenario_problems:
+        ok = False
+        print(f"scenario-rule lint: {problem}", file=sys.stderr)
     from swim_tpu.obs.health import HEALTH_RULES
     from swim_tpu.obs.prof import PROF_GAUGES
+    from swim_tpu.sim.scenario import LIBRARY
 
     print(f"checked {len(keys)} stats keys against "
           f"{len(NODE_COUNTERS)} declared counters, "
-          f"{len(HEALTH_RULES)} health gauges and "
-          f"{len(PROF_GAUGES)} profiler gauges: "
+          f"{len(HEALTH_RULES)} health gauges, "
+          f"{len(PROF_GAUGES)} profiler gauges and "
+          f"{len(LIBRARY)} library scenarios: "
           f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
 

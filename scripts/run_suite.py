@@ -29,6 +29,15 @@ captured artifacts also fails the run.
 sub-5-minute smoke tier (host-side protocol logic, harness registries,
 roofline math, observability, bridge conformance, profiler contracts)
 for pre-push iteration; the full per-file suite stays the CI tier.
+
+The SCENARIO gate (round 8): after the suite, FAST_SCENARIOS runs the
+library's sub-minute adversarial fault scenarios through `swim-tpu
+scenario run <name> --check` — each must produce a passing verdict
+(observatory error gate + the spec's expectations).  On by default
+whenever --artifacts-dir is given; force with --scenarios on/off.
+Scenario outputs land in <artifacts-dir>/scenarios, deliberately
+OUTSIDE the raw top-level telemetry sweep: ungated contrast arms dump
+error findings on purpose, and the verdict is their gate-aware judge.
 """
 from __future__ import annotations
 
@@ -60,6 +69,53 @@ FAST_FILES = (
     "tests/test_graft_entry.py",
     "tests/test_sampling.py",
 )
+
+# Scenario gate: the library's sub-minute adversarial scenarios, run via
+# `swim-tpu scenario run <name> --check` after the suite (one process
+# per scenario, same isolation rationale as the per-file loop).  Each
+# must produce a PASSING verdict artifact — the observatory error gate
+# plus the spec's own expectations.  baseline_config3 (n=100k, 4 arms)
+# is library-only, far too heavy for CI.
+FAST_SCENARIOS = (
+    "rack_outage",
+    "flap",
+    "gray_10pct",
+    "replay_storm",
+    "lean_fidelity",
+)
+
+
+def run_scenarios(out_dir: str, timeout: float, env: dict) -> list[str]:
+    """Run the FAST_SCENARIOS gate; return failure labels ([] = green).
+
+    Verdict artifacts + flight dumps land in `out_dir` so the analyzer
+    sweep that follows also replays the scenario telemetry."""
+    failures: list[str] = []
+    os.makedirs(out_dir, exist_ok=True)
+    for name in FAST_SCENARIOS:
+        t0 = time.time()
+        p = subprocess.Popen(
+            [sys.executable, "-m", "swim_tpu.cli", "scenario", "run",
+             name, "--check", "--out-dir", out_dir],
+            cwd=REPO, env=env, text=True, start_new_session=True,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            out, _ = p.communicate(timeout=timeout)
+            rc = p.returncode
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            out, rc = f"TIMEOUT after {timeout:.0f}s", None
+        dt = time.time() - t0
+        mark = "PASS" if rc == 0 else "FAIL"
+        print(f"{mark} scenario:{name:32s} {dt:7.1f}s", flush=True)
+        if rc != 0:
+            for line in (out or "").strip().splitlines()[-10:]:
+                print(f"  {line}", flush=True)
+            failures.append(f"scenario:{name}")
+    return failures
 
 
 def analyze_artifacts(dest: str) -> list[str]:
@@ -116,6 +172,12 @@ def main() -> int:
     ap.add_argument("--fast", action="store_true",
                     help="run the curated <5-minute smoke tier "
                          "(FAST_FILES) instead of the full suite")
+    ap.add_argument("--scenarios", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="run the FAST_SCENARIOS adversarial gate "
+                         "(swim-tpu scenario run --check) after the "
+                         "suite; 'auto' = on when --artifacts-dir is "
+                         "given (the gated CI path)")
     args = ap.parse_args()
 
     if args.fast and args.pattern == "tests/test_*.py":
@@ -185,6 +247,17 @@ def main() -> int:
     print(f"\n{len(files) - len(failures)}/{len(files)} files green "
           f"in {time.time() - t_all:.0f}s"
           + (f"; FAILED: {', '.join(failures)}" if failures else ""))
+    if args.scenarios == "on" or (args.scenarios == "auto"
+                                  and args.artifacts_dir):
+        # Scenario outputs go to a SUBDIRECTORY of the artifacts dir:
+        # ungated contrast arms (flap storm, gray vanilla) dump
+        # telemetry whose error findings are the scenario's point —
+        # the verdict is the gate-aware judge for those, so they must
+        # stay out of analyze_artifacts' raw top-level *.jsonl sweep.
+        scen_dir = os.path.join(
+            args.artifacts_dir or os.path.join(REPO, "suite_scenarios"),
+            "scenarios")
+        failures += run_scenarios(scen_dir, args.timeout_per_file, env)
     if args.artifacts_dir:
         copied = collect_artifacts(args.artifacts_dir)
         print(f"artifacts -> {args.artifacts_dir} ({len(copied)}):")

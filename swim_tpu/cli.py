@@ -426,6 +426,49 @@ def _cmd_bridge(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_scenario(args: argparse.Namespace) -> int:
+    from swim_tpu.sim import scenario
+
+    if args.action == "list":
+        rows = []
+        for name in sorted(scenario.LIBRARY):
+            sc = scenario.LIBRARY[name]
+            mode = sc.study or sc.engine
+            rows.append((name, mode, sc.n,
+                         sc.description.split(".  ")[0].rstrip(".")))
+        if args.json:
+            print(json.dumps([{"name": n, "mode": m, "n": nn, "about": d}
+                              for n, m, nn, d in rows], indent=1))
+        else:
+            w = max(len(r[0]) for r in rows)
+            for n, m, nn, d in rows:
+                print(f"{n:<{w}}  {m:<9} n={nn:<7} {d}")
+        return 0
+    if args.name is None:
+        print("scenario show/run need a scenario name "
+              f"(one of {sorted(scenario.LIBRARY)})", file=sys.stderr)
+        return 2
+    sc = scenario.get(args.name)
+    if args.action == "show":
+        scenario.validate(sc)
+        print(json.dumps(sc.spec_dict(), indent=1, sort_keys=True))
+        return 0
+    verdict, path = scenario.run(sc, out_dir=args.out_dir)
+    if args.json:
+        print(json.dumps(verdict, indent=1, sort_keys=True,
+                         default=str))
+    else:
+        print(f"{sc.name}: {verdict['verdict']}  -> {path}")
+        for c in verdict["checks"]:
+            mark = "ok " if c["ok"] else "FAIL"
+            detail = {k: v for k, v in c.items()
+                      if k not in ("check", "ok", "fired")}
+            print(f"  [{mark}] {c['check']} {json.dumps(detail, default=str)}")
+    if args.check and verdict["verdict"] != "pass":
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="swim-tpu",
@@ -542,6 +585,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="exit 1 if any error-severity health finding "
                          "(CI gate)")
     ob.set_defaults(fn=_cmd_observe)
+
+    sc = sub.add_parser(
+        "scenario", help="compile & run adversarial fault scenarios "
+                         "(sim/scenario.py library) gated by the "
+                         "observatory")
+    sc.add_argument("action", choices=("list", "show", "run"))
+    sc.add_argument("name", nargs="?", default=None,
+                    help="library scenario name (hyphens ok: "
+                         "rack-outage, flap, gray-10pct, replay-storm, "
+                         "baseline-config3, lean-fidelity)")
+    sc.add_argument("--out-dir", default="bench_results",
+                    help="where verdict artifacts + telemetry dumps go")
+    sc.add_argument("--json", action="store_true",
+                    help="emit the full verdict JSON")
+    sc.add_argument("--check", action="store_true",
+                    help="exit 1 unless every scenario check passes "
+                         "(CI gate)")
+    sc.set_defaults(fn=_cmd_scenario)
 
     pr = sub.add_parser(
         "profile", help="phase-level step attribution with roofline "

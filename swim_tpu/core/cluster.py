@@ -17,13 +17,17 @@ from swim_tpu.types import Status
 
 class SimCluster:
     def __init__(self, cfg: SwimConfig, seed: int = 0, loss: float = 0.0,
-                 latency: float = 0.001, trace=None):
+                 latency: float = 0.001, trace=None,
+                 duplicate: float = 0.0, replay: float = 0.0):
         # `trace`: optional swim_tpu.obs.trace.TraceSink shared by every
-        # node — probe/suspicion lifecycle spans from the whole cluster
+        # node — probe/suspicion lifecycle spans from the whole cluster.
+        # `duplicate`/`replay`: adversarial delivery (SimNetwork), the
+        # replay-storm scenario's idempotence workload.
         self.cfg = cfg
         self.clock = SimClock()
         self.network = SimNetwork(self.clock, seed=seed, loss=loss,
-                                  latency=latency)
+                                  latency=latency, duplicate=duplicate,
+                                  replay=replay)
         self.nodes: list[Node] = []
         roster = []
         for i in range(cfg.n_nodes):

@@ -57,17 +57,32 @@ class SimNetwork:
     """
 
     def __init__(self, clock: SimClock, seed: int = 0, loss: float = 0.0,
-                 latency: float = 0.001):
+                 latency: float = 0.001, duplicate: float = 0.0,
+                 replay: float = 0.0, replay_buffer: int = 256):
         self.clock = clock
         self.rng = random.Random(seed)
         self.loss = loss
         self.latency = latency
+        # adversarial delivery (sim/scenario.py replay-storm): `duplicate`
+        # is the probability a delivered datagram arrives twice; `replay`
+        # the probability each transmit additionally re-delivers a random
+        # STALE datagram (same src/dst as when first sent — old
+        # incarnations included), from a bounded history.  Both ride the
+        # same seeded rng/clock, so runs stay deterministic.  The decode
+        # path must be idempotent under both (core/node.py merges are
+        # monotone lattice joins; tests/test_scenario.py pins it).
+        self.duplicate = duplicate
+        self.replay = replay
+        self._replay_buffer = replay_buffer
+        self._history: list[tuple[Address, Address, bytes]] = []
         self._link_latency: dict[frozenset[Address], float] = {}
         self._endpoints: dict[Address, "InProcessTransport"] = {}
         self._cut: set[frozenset[Address]] = set()
         self._down: set[Address] = set()
         self.sent = 0
         self.delivered = 0
+        self.duplicated = 0
+        self.replayed = 0
 
     def attach(self, ep: "InProcessTransport") -> None:
         self._endpoints[ep.local_address] = ep
@@ -109,11 +124,33 @@ class SimNetwork:
 
     def transmit(self, src: Address, dst: Address, payload: bytes) -> None:
         self.sent += 1
+        if self.replay and self._history:
+            # stale replay rides on traffic: each transmit may re-deliver
+            # one random datagram from the bounded history (possibly
+            # carrying an out-of-date incarnation)
+            if self.rng.random() < self.replay:
+                rsrc, rdst, rpayload = self._history[
+                    self.rng.randrange(len(self._history))]
+                self.replayed += 1
+                self._schedule(rsrc, rdst, rpayload)
         if src in self._down or dst in self._down:
             return
         if frozenset((src, dst)) in self._cut:
             return
         if self.loss and self.rng.random() < self.loss:
+            return
+        if self.duplicate or self.replay:
+            self._history.append((src, dst, payload))
+            if len(self._history) > self._replay_buffer:
+                del self._history[:len(self._history) - self._replay_buffer]
+        self._schedule(src, dst, payload)
+        if self.duplicate and self.rng.random() < self.duplicate:
+            self.duplicated += 1
+            self._schedule(src, dst, payload)
+
+    def _schedule(self, src: Address, dst: Address,
+                  payload: bytes) -> None:
+        if dst in self._down or frozenset((src, dst)) in self._cut:
             return
         ep = self._endpoints.get(dst)
         if ep is None:

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.ops import lattice, sampling
+from swim_tpu.sim import faults
 from swim_tpu.sim.faults import FaultPlan
 from swim_tpu.utils.prng import PeriodRandomness, draw_period
 
@@ -121,6 +122,7 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     tap, prof=None leaves the traced program unchanged.
     """
     n, k = cfg.n_nodes, cfg.k_indirect
+    plan, prog = faults.split_program(plan)
     t = state.step
     key, retransmit, deadline, lha = (state.key, state.retransmit,
                                       state.deadline, state.lha)
@@ -132,11 +134,26 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
     up = ~crashed & joined
     part_on = ((t >= plan.partition_start) & (t < plan.partition_end))
 
-    def delivered(src, dst, u):
+    if prog is not None:
+        # u16 lane thresholds -> exact f32 probabilities (the scale is
+        # a power of two, so thr * 2^-16 is exact); composed with the
+        # global loss by saturating addition, matching the ring
+        # engine's integer composition
+        send_thr, recv_thr, reply_thr = faults.link_lanes(prog, t)
+        scale = jnp.float32(1.0 / 65536.0)
+        send_f = send_thr.astype(jnp.float32) * scale
+        recv_f = recv_thr.astype(jnp.float32) * scale
+        reply_f = reply_thr.astype(jnp.float32) * scale
+
+    def delivered(src, dst, u, reply=False):
         """Fault mask for a batch of directed messages (docs/PROTOCOL.md §3)."""
         cut = part_on & (plan.partition_id[src] != plan.partition_id[dst])
-        return (up[src] & up[dst] & ~cut
-                & (u >= plan.loss.astype(jnp.float32)))
+        thr = plan.loss.astype(jnp.float32)
+        if prog is not None:
+            thr = thr + send_f[src] + recv_f[dst]
+            if reply:
+                thr = thr + reply_f[src]
+        return up[src] & up[dst] & ~cut & (u >= thr)
 
     # ---- Phase A: all random choices --------------------------------------
     not_dead = ~lattice.is_dead(key)
@@ -179,11 +196,12 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
         return jnp.where(lattice.is_suspect(cur_key[src, dst]), dst,
                          jnp.int32(-1))
 
-    def wave(carry, src, dst, sent, u_loss, forced):
+    def wave(carry, src, dst, sent, u_loss, forced, reply=False):
         """Run one message wave; returns updated carry and delivered mask.
 
         carry = (key, retransmit, deadline). src/dst/sent/u_loss/forced are
-        flat message arrays of equal length M (static).
+        flat message arrays of equal length M (static).  `reply` marks
+        ack legs (W2/W5/W6) for the FaultProgram gray lane.
         """
         key, retransmit, deadline = carry
         sel_idx, sel_valid = _piggyback(cfg, retransmit)   # wave-start state
@@ -195,7 +213,7 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
         # counters advance for every sent message, delivered or not
         retransmit = retransmit.at[src[:, None], msel].add(
             mval.astype(jnp.int32))
-        ok = sent & delivered(src, dst, u_loss)            # [M]
+        ok = sent & delivered(src, dst, u_loss, reply)     # [M]
         dval = mval & ok[:, None]
         new_key = key.at[dst[:, None], msel].max(
             jnp.where(dval, payload, jnp.uint32(0)))
@@ -215,7 +233,8 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
                         buddy(carry[0], ids, target))
     # W2: acks T(i) → i (one per delivered ping, indexed by pinger i)
     no_force = jnp.full((n,), -1, jnp.int32)
-    carry, w2_ok = wave(carry, target, ids, w1_ok, rnd.loss_w2, no_force)
+    carry, w2_ok = wave(carry, target, ids, w1_ok, rnd.loss_w2, no_force,
+                        reply=True)
     acked = w2_ok
     # W3: ping-req i → proxies, for probers with no direct ack
     need = prober & ~acked & has_proxy
@@ -230,10 +249,10 @@ def step(cfg: SwimConfig, state: DenseState, plan: FaultPlan,
                         buddy(carry[0], dst3, tgt4))
     # W5: target acks T(i) → p
     carry, w5_ok = wave(carry, tgt4, dst3, w4_ok, rnd.loss_w5.reshape(-1),
-                        jnp.full((n * k,), -1, jnp.int32))
+                        jnp.full((n * k,), -1, jnp.int32), reply=True)
     # W6: relay acks p → i
     carry, w6_ok = wave(carry, dst3, src3, w5_ok, rnd.loss_w6.reshape(-1),
-                        jnp.full((n * k,), -1, jnp.int32))
+                        jnp.full((n * k,), -1, jnp.int32), reply=True)
     key, retransmit, deadline = carry
     relayed = jnp.any(w6_ok.reshape(n, k), axis=-1)
 

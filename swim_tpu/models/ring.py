@@ -107,6 +107,7 @@ import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.ops import coldsel, lattice, sampling, selb, wavemerge, wavepack
+from swim_tpu.sim import faults
 from swim_tpu.sim.faults import FaultPlan
 
 WORD = 32
@@ -763,6 +764,11 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     """
     if ops is None:
         ops = GlobalOps(cfg)
+    # FaultProgram plans split into (base FaultPlan, program-or-None);
+    # prog is None for plain plans AND zero-segment programs, so the
+    # empty scenario traces the exact graph a FaultPlan does (the
+    # bitwise-parity contract pinned by tests/test_scenario.py).
+    plan, prog = faults.split_program(plan)
     g = geometry(cfg)
     n, k = cfg.n_nodes, cfg.k_indirect
     r_tot, s_cap = g.rw * WORD, cfg.sentinels
@@ -954,6 +960,17 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
     # exactly (see RingRandomness); 65536*loss is an exact exponent
     # shift in f32 and ceil is exact, so no boundary sample can flip
     loss_thr = jnp.ceil(loss_f * jnp.float32(65536.0)).astype(jnp.uint32)
+    if prog is not None:
+        # per-node u16 lanes at period t, same integer geometry as
+        # loss_thr: a leg delivers iff u >= loss_thr + send lane (rolled
+        # from the sender) + local recv lane.  Reply legs (acks) use the
+        # saturated send+reply lane — gray nodes gossip fine but their
+        # acks get lost (Lifeguard's gray-failure workload).
+        send_thr, recv_thr, reply_thr = faults.link_lanes(prog, t)
+        send_thr16 = send_thr.astype(jnp.uint16)
+        resp_thr16 = jnp.minimum(
+            send_thr + reply_thr,
+            jnp.uint32(faults.LANE_MAX)).astype(jnp.uint16)
     b_pig = min(cfg.max_piggyback, g.ww * WORD)
     win_slots_lin = jnp.mod(win_ring0 * WORD
                             + jnp.arange(g.ww * WORD, dtype=jnp.int32),
@@ -1069,29 +1086,52 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
                       == col[:, None])
             return jnp.where(onehot, val[:, None], jnp.uint32(0))
 
-        def wave_ok(send_flag_at_sender, d, u, cv=None):
+        def wave_ok(send_flag_at_sender, d, u, cv=None, reply=False):
             """(ok bool[N], cv') per receiver i: the message from (i+d)
             arrived.  The ok chain needs the sender's flag and partition
             id at the receiver; on the packed wire those — plus the
-            wave's buddy (col, code), when given — fuse into ONE
-            roll_bundle payload, so cv' comes back receiver-aligned.
-            On the wide wire each vector rolls separately and cv passes
-            through sender-aligned (the fused staging rolls it)."""
+            wave's link lane (u16, program plans only) and buddy
+            (col, code), when given — fuse into ONE roll_bundle payload,
+            so cv' comes back receiver-aligned.  On the wide wire each
+            vector rolls separately and cv passes through sender-aligned
+            (the fused staging rolls it).  `reply` marks ack legs (W2/
+            W5/W6): those roll the saturated send+reply lane instead of
+            the plain send lane."""
+            lane = None
+            if prog is not None:
+                lane = resp_thr16 if reply else send_thr16
             if scalar_packed:
-                parts = (send_flag_at_sender, pid) + (cv or ())
-                labels = ("roll_ok_waves", "roll_pid_waves",
-                          "roll_buddy_cols", "roll_buddy_vals")
-                rolled = ops.roll_bundle(parts, d,
-                                         labels=labels[:len(parts)])
+                parts = [send_flag_at_sender, pid]
+                labels = ["roll_ok_waves", "roll_pid_waves"]
+                if lane is not None:
+                    parts.append(lane)
+                    labels.append("roll_link_thr")
+                if cv is not None:
+                    parts.extend(cv)
+                    labels.extend(["roll_buddy_cols", "roll_buddy_vals"])
+                rolled = ops.roll_bundle(tuple(parts), d,
+                                         labels=tuple(labels))
                 flag_r, pid_r = rolled[0], rolled[1]
-                cvr = tuple(rolled[2:]) if cv is not None else None
+                nxt = 2
+                if lane is not None:
+                    lane_r = rolled[nxt]
+                    nxt += 1
+                cvr = tuple(rolled[nxt:]) if cv is not None else None
             else:
                 flag_r = roll_from(send_flag_at_sender, d,
                                    label="roll_ok_waves")
                 pid_r = roll_from(pid, d, label="roll_pid_waves")
+                if lane is not None:
+                    lane_r = roll_from(lane, d, label="roll_link_thr")
                 cvr = cv
+            if lane is None:
+                thr = loss_thr
+            else:
+                # u <= 65535, so a composed threshold >= 65536 is
+                # "never deliver"; all-u32 arithmetic, no overflow
+                thr = loss_thr + lane_r.astype(jnp.uint32) + recv_thr
             ok = (flag_r & active & ~(part_on & (pid_r != pid))
-                  & (u >= loss_thr))
+                  & (u >= thr))
             return ok, cvr
 
         # Period scope: every wave ORs the SAME start-of-period selection
@@ -1130,7 +1170,8 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         deliver(ok1, -s_off, cv1)
         # W2: ack j=i+s -> i (acks iff the ping arrived; ok1 is indexed
         # by j already).  Receiver i hears from i+s.
-        ok2, _ = wave_ok(ok1, s_off, rnd.loss_w2)            # per recv i
+        ok2, _ = wave_ok(ok1, s_off, rnd.loss_w2,
+                         reply=True)                         # per recv i
         deliver(ok2, s_off)
         acked = ok2 & prober
 
@@ -1149,11 +1190,13 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             deliver(ok4, -d4, cv4)
             # W5: target ack j -> j−d4 (back to proxy p).  Receiver p
             # hears from p+d4.
-            ok5, _ = wave_ok(ok4, d4, rnd.loss_w5[:, a])     # per recv p
+            ok5, _ = wave_ok(ok4, d4, rnd.loss_w5[:, a],
+                             reply=True)                     # per recv p
             deliver(ok5, d4)
             # W6: relay ack p -> p−q (back to prober i).  Receiver i
             # hears from i+q.
-            ok6, _ = wave_ok(ok5, q, rnd.loss_w6[:, a])      # per recv i
+            ok6, _ = wave_ok(ok5, q, rnd.loss_w6[:, a],
+                             reply=True)                     # per recv i
             deliver(ok6, q)
             relayed = relayed | (ok6 & need)
 
@@ -1310,6 +1353,15 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             raise NotImplementedError(
                 "pull-uniform probing needs arbitrary-row exchanges; "
                 "this ops layout does not provide them")
+        if prog is not None:
+            # pull mode draws each contact at an env-side COMPOSED
+            # probability (deviations P3/P4) and never sees individual
+            # legs, so per-node lane programs have no sound insertion
+            # point — scenario specs with link/gray segments must use
+            # the rotor probe.
+            raise NotImplementedError(
+                "FaultProgram link/gray segments are not supported by "
+                "pull-uniform probing; use ring_probe='rotor'")
         pr = rnd.pull
         sel_all = sel_now(no_force)
         # P(m_j = 0) = (1 − 1/(M−1))^{L_j}: a live prober picks uniformly

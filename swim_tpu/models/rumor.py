@@ -63,6 +63,7 @@ import jax.numpy as jnp
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.ops import lattice, sampling
+from swim_tpu.sim import faults
 from swim_tpu.sim.faults import FaultPlan
 from swim_tpu.utils.prng import PeriodRandomness, draw_period
 
@@ -227,6 +228,7 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     """
     n, k, r_cap = cfg.n_nodes, cfg.k_indirect, cfg.rumor_slots
     s_cap = cfg.sentinels
+    plan, prog = faults.split_program(plan)
     t = state.step
     base = rnd.base
     ids = jnp.arange(n, dtype=jnp.int32)
@@ -311,10 +313,23 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     prox = prox + (prox >= hi[:, None]).astype(jnp.int32)   # i32[N, k]
     has_proxy = n > 2
 
-    def delivered(src, dst, u):
+    if prog is not None:
+        # u16 lane thresholds -> exact f32 probabilities (power-of-two
+        # scale), composed with the global loss like the dense engine
+        send_thr, recv_thr, reply_thr = faults.link_lanes(prog, t)
+        scale = jnp.float32(1.0 / 65536.0)
+        send_f = send_thr.astype(jnp.float32) * scale
+        recv_f = recv_thr.astype(jnp.float32) * scale
+        reply_f = reply_thr.astype(jnp.float32) * scale
+
+    def delivered(src, dst, u, reply=False):
         cut = part_on & (plan.partition_id[src] != plan.partition_id[dst])
-        return (up[src] & up[dst] & ~cut
-                & (u >= plan.loss.astype(jnp.float32)))
+        thr = plan.loss.astype(jnp.float32)
+        if prog is not None:
+            thr = thr + send_f[src] + recv_f[dst]
+            if reply:
+                thr = thr + reply_f[src]
+        return up[src] & up[dst] & ~cut & (u >= thr)
 
     # ---- Phase B: global piggyback candidates (deviation 1) ---------------
     b_pig = min(cfg.max_piggyback, r_cap)
@@ -378,17 +393,18 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
             val = vals > 0
         return jnp.take(cand_idx, wpos), val
 
-    def wave(knows, src, dst, sent, u_loss, forced):
+    def wave(knows, src, dst, sent, u_loss, forced, reply=False):
         """One message wave: per-sender top-B selection + scatter-OR merge.
 
         src/dst/sent/u_loss/forced are flat [M] message arrays; forced is a
         rumor index (-1 = none) force-included by the Lifeguard buddy rule
         (added alongside the B selected — exact SWIM displaces the last
-        slot; deviation noted in the module docstring).
+        slot; deviation noted in the module docstring).  `reply` marks
+        ack legs (W2/W5/W6) for the FaultProgram gray lane.
         """
         kn = knows[:, cand_idx] & cand_valid[None, :]         # [N, W]
         sel, val = select_first_b(kn)
-        ok = sent & delivered(src, dst, u_loss)               # [M]
+        ok = sent & delivered(src, dst, u_loss, reply)        # [M]
         upd = val[src] & ok[:, None]                          # [M, B]
         knows = knows.at[dst[:, None], sel[src]].max(upd)
         fok = ok & (forced >= 0)
@@ -415,7 +431,8 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
     knows, w1_ok = wave(knows, ids, target, prober, base.loss_w1,
                         buddy(knows, ids, target))
     # W2 ACK T(i)→i
-    knows, w2_ok = wave(knows, target, ids, w1_ok, base.loss_w2, no_force)
+    knows, w2_ok = wave(knows, target, ids, w1_ok, base.loss_w2, no_force,
+                        reply=True)
     acked = w2_ok
     # W3 PING-REQ i→p
     need = prober & ~acked & has_proxy
@@ -427,10 +444,10 @@ def step(cfg: SwimConfig, state: RumorState, plan: FaultPlan,
                         buddy(knows, dst3, tgt4))
     # W5 target ACK T(i)→p
     knows, w5_ok = wave(knows, tgt4, dst3, w4_ok, base.loss_w5.reshape(-1),
-                        no_force_k)
+                        no_force_k, reply=True)
     # W6 relay ACK p→i
     knows, w6_ok = wave(knows, dst3, src3, w5_ok, base.loss_w6.reshape(-1),
-                        no_force_k)
+                        no_force_k, reply=True)
     relayed = jnp.any(w6_ok.reshape(n, k), axis=-1)
     st = st._replace(knows=knows)
 

@@ -68,6 +68,14 @@ HEALTH_RULES: dict[str, tuple[str, str]] = {
     "node_decode_errors": (
         "error",
         "Real-node wire codec dropped datagrams (decode errors)"),
+    "gray_undetected": (
+        "warn",
+        "Gray-degraded nodes present for a full window but zero probe "
+        "failures — the detector is blind to the degradation"),
+    "flap_false_dead": (
+        "error",
+        "False-dead views grew while links were flapping (healthy nodes "
+        "declared dead by link churn)"),
 }
 
 # default thresholds; override per-monitor via HealthMonitor(thresholds=)
@@ -224,6 +232,26 @@ class HealthMonitor:
                          f"{len(rows)} periods")
 
         full = len(rows) == self.window
+        # scenario rules: the scenario runner (sim/scenario.py) injects
+        # per-period `gray_nodes` / `flap_active` gauges recomputed from
+        # the compiled FaultProgram, so these rules see the INTENDED
+        # fault schedule next to the protocol's observed reaction.
+        if full and all(r.get("gray_nodes", 0) > 0 for r in rows) \
+                and sum(r.get("probes_failed", 0) for r in rows) == 0:
+            fire("gray_undetected", "warn", latest.get("gray_nodes", 0),
+                 0,
+                 f"{latest.get('gray_nodes', 0)} gray-degraded node(s) "
+                 f"for {self.window} periods with zero probe failures")
+
+        if len(rows) >= 2 and any(r.get("flap_active", 0) > 0
+                                  for r in rows):
+            fd_delta = (latest.get("false_dead_views", 0)
+                        - rows[0].get("false_dead_views", 0))
+            if fd_delta > 0:
+                fire("flap_false_dead", "error", fd_delta, 0,
+                     f"false-dead views grew by {fd_delta} while links "
+                     f"were flapping")
+
         if full and all(r.get("waves_delivered", 0) == 0 for r in rows) \
                 and all(r.get("win_occupancy", 0) > 0 for r in rows):
             fire("stalled_dissemination", "error",
@@ -257,7 +285,8 @@ class HealthMonitor:
 
         for rule in ("false_dead_views", "stalled_dissemination",
                      "overflow_growth", "probe_failure_burst",
-                     "index_overflow_growth", "saturation_spike"):
+                     "index_overflow_growth", "saturation_spike",
+                     "gray_undetected", "flap_false_dead"):
             if rule in fired:
                 self._active[rule] = fired[rule].severity
                 self._record(fired[rule])

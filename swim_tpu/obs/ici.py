@@ -12,8 +12,11 @@ reductions/replicated gathers and the [D, kl] candidate all_gather.
 
 Scalar rolls tally under STABLE NAMED TERMS — the engine labels every
 node-vector roll at the call site (roll_probe_gate, roll_ok_waves,
-roll_pid_waves, roll_buddy_slots, roll_buddy_cols, roll_buddy_vals,
-roll_view_slots, roll_view_known, roll_view_verdict) — so artifacts
+roll_pid_waves, roll_link_thr, roll_buddy_slots, roll_buddy_cols,
+roll_buddy_vals, roll_view_slots, roll_view_known, roll_view_verdict)
+— roll_link_thr is the FaultProgram per-wave u16 link-lane
+(sim/faults.py link_lanes; absent for plain FaultPlan runs, so the
+baseline ICI bill is unchanged by construction) — so artifacts
 compare across wire formats and dtype changes instead of keying on
 shapes.  The shape/dtype-derived `roll[...]` key survives only as the
 fallback for unlabeled rolls.  With `cfg.ring_scalar_wire == "packed"`
@@ -36,9 +39,14 @@ from __future__ import annotations
 V5E_ICI_GBPS = 45.0   # v5e ICI, per link per direction (public figure)
 
 
-def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS) -> dict:
+def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS,
+                    plan=None) -> dict:
     """Per-chip ICI bytes/period the ShardOps layout moves for `cfg`
-    sharded over `d` devices, keyed by collective (trace-derived)."""
+    sharded over `d` devices, keyed by collective (trace-derived).
+    `plan` defaults to `faults.none` (the baseline bill, unchanged);
+    pass a FaultProgram to price its per-wave u16 link lane — the
+    `roll_link_thr` term (sim/scenario.py embeds this in verdict
+    artifacts)."""
     import jax
     import jax.numpy as jnp
 
@@ -124,9 +132,9 @@ def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS) -> dict:
 
     def one_period():
         st = ring.init_state(cfg)
-        plan = faults.none(cfg.n_nodes)
+        pl = plan if plan is not None else faults.none(cfg.n_nodes)
         rnd = ring.draw_period_ring(jax.random.key(0), jnp.int32(0), cfg)
-        return ring.step(cfg, st, plan, rnd, ops=ops_c)
+        return ring.step(cfg, st, pl, rnd, ops=ops_c)
 
     jax.eval_shape(one_period)
     total = sum(tally.values())

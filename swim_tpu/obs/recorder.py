@@ -40,8 +40,11 @@ VERSION = 1
 # Host-side per-period counters the study runners produce NEXT TO the
 # engine tap (sim/runner.py PeriodSeries) that are worth recording in
 # the same row — accepted by `record`, round-tripped through dumps, and
-# visible to the health monitor's rules.
-AUX_FIELDS = ("false_dead_views",)
+# visible to the health monitor's rules.  `gray_nodes` / `flap_active`
+# are fault-schedule gauges the scenario runner (sim/scenario.py)
+# recomputes from the compiled FaultProgram, feeding the
+# gray_undetected / flap_false_dead health rules.
+AUX_FIELDS = ("false_dead_views", "gray_nodes", "flap_active")
 
 
 class FlightRecorder:

@@ -73,6 +73,11 @@ def _spec_fn(state, mesh: Mesh, n: int | None):
     fields = getattr(state, "_fields", ())
 
     def spec_of(name, x):
+        if hasattr(x, "_fields"):
+            # nested NamedTuple (e.g. FaultProgram.base): recurse so the
+            # spec pytree mirrors the state structure leaf-for-leaf
+            return type(x)(*(spec_of(nm, y)
+                             for nm, y in zip(x._fields, x)))
         axis = overrides.get(name, 0)
         if (getattr(x, "ndim", 0) > axis and x.shape[axis] == nn):
             return node_sharding(mesh, x.ndim, axis)

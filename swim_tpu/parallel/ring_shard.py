@@ -85,7 +85,7 @@ from swim_tpu.models import ring
 from swim_tpu.obs.engine import EngineFrame, frame_from_tap
 from swim_tpu.ops import wavepack
 from swim_tpu.parallel import mesh as pmesh
-from swim_tpu.sim.faults import FaultPlan
+from swim_tpu.sim.faults import FaultPlan, FaultProgram
 
 AXIS = pmesh.NODE_AXIS
 
@@ -410,10 +410,18 @@ def _state_specs(cfg: SwimConfig) -> ring.RingState:
         confirmed=P(), overflow=P(), index_overflow=P(), step=P())
 
 
-def _plan_specs() -> FaultPlan:
-    return FaultPlan(crash_step=P(AXIS), loss=P(), partition_id=P(AXIS),
+def _plan_specs(program: bool = False):
+    base = FaultPlan(crash_step=P(AXIS), loss=P(), partition_id=P(AXIS),
                      partition_start=P(), partition_end=P(),
                      join_step=P(AXIS))
+    if not program:
+        return base
+    # FaultProgram: node-axis lanes shard with the nodes; the segment
+    # table is a handful of scalars per segment — replicated
+    return FaultProgram(
+        base=base, domain_id=P(AXIS),
+        seg_start=P(), seg_end=P(), seg_period=P(), seg_on=P(),
+        seg_domain=P(), seg_kind=P(), seg_level=P())
 
 
 def _rnd_specs(cfg: SwimConfig) -> ring.RingRandomness:
@@ -444,20 +452,22 @@ def _check(cfg: SwimConfig, mesh) -> int:
     return d
 
 
-def place(cfg: SwimConfig, mesh, state: ring.RingState, plan: FaultPlan):
-    """Device_put state + plan onto the mesh per this engine's specs."""
+def place(cfg: SwimConfig, mesh, state: ring.RingState, plan):
+    """Device_put state + plan onto the mesh per this engine's specs.
+    `plan` may be a FaultPlan or a FaultProgram — pass the matching
+    `program=` flag to build_step/build_run/mapped_step."""
     _check(cfg, mesh)
     st = jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         state, _state_specs(cfg))
     pl = jax.tree.map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
-        plan, _plan_specs())
+        plan, _plan_specs(program=isinstance(plan, FaultProgram)))
     return st, pl
 
 
 @functools.lru_cache(maxsize=64)
-def mapped_step(cfg: SwimConfig, mesh):
+def mapped_step(cfg: SwimConfig, mesh, program: bool = False):
     """The shard_mapped (unjitted) step(state, plan, rnd) — the single
     source of the engine's specs; nestable inside callers' scans (the
     study runner passes it to run_study_ring).  Memoized per
@@ -506,16 +516,17 @@ def mapped_step(cfg: SwimConfig, mesh):
 
     return shard_map(
         _step, mesh=mesh,
-        in_specs=(_state_specs(cfg), _plan_specs(), _rnd_specs(cfg)),
+        in_specs=(_state_specs(cfg), _plan_specs(program), _rnd_specs(cfg)),
         out_specs=out_specs, check_rep=False)
 
 
-def build_step(cfg: SwimConfig, mesh):
-    """jitted step(state, plan, rnd) with explicit collectives."""
-    return jax.jit(mapped_step(cfg, mesh))
+def build_step(cfg: SwimConfig, mesh, program: bool = False):
+    """jitted step(state, plan, rnd) with explicit collectives.
+    `program=True` expects a FaultProgram plan pytree (sim/scenario.py)."""
+    return jax.jit(mapped_step(cfg, mesh, program))
 
 
-def build_run(cfg: SwimConfig, mesh, periods: int):
+def build_run(cfg: SwimConfig, mesh, periods: int, program: bool = False):
     """jitted run(state, plan, root_key): `periods` under one lax.scan,
     randomness drawn inside the scan exactly as ring.run does.
 
@@ -523,7 +534,7 @@ def build_run(cfg: SwimConfig, mesh, periods: int):
     field is a [periods]-stacked i32 series (the flight-recorder feed);
     with cfg.profiling the [periods, len(PHASES)] marker matrix is
     appended; otherwise just the final state."""
-    sm = mapped_step(cfg, mesh)
+    sm = mapped_step(cfg, mesh, program)
     extras = cfg.telemetry or cfg.profiling
 
     def run(state, plan, root_key):

@@ -69,6 +69,7 @@ from swim_tpu.models import rumor
 from swim_tpu.models.rumor import RumorRandomness, RumorState
 from swim_tpu.ops import lattice, sampling
 from swim_tpu.parallel.mesh import NODE_AXIS
+from swim_tpu.sim import faults
 from swim_tpu.sim.faults import FaultPlan
 
 AX = NODE_AXIS
@@ -598,6 +599,7 @@ def build_step(cfg: SwimConfig, mesh, exchange_slack: int | None = None):
     jitted = jax.jit(smapped)
 
     def stepper(state: RumorState, plan: FaultPlan, rnd):
+        plan = _accept_plan(plan)
         _reject_join_plans(plan)
         return jitted(state, plan, rnd)
 
@@ -622,10 +624,24 @@ def build_run(cfg: SwimConfig, mesh, periods: int,
     jitted = jax.jit(runner)
 
     def guarded(state: RumorState, plan: FaultPlan, root_key):
+        plan = _accept_plan(plan)
         _reject_join_plans(plan)
         return jitted(state, plan, root_key)
 
     return guarded
+
+
+def _accept_plan(plan) -> FaultPlan:
+    """This engine's shard_map specs model a plain FaultPlan: unwrap
+    zero-segment FaultPrograms (identical by the parity contract) and
+    refuse real lane programs — the sharded RING exchange carries
+    those (parallel/ring_shard.py program=True)."""
+    base, prog = faults.split_program(plan)
+    if prog is not None:
+        raise NotImplementedError(
+            "the sharded rumor exchange does not carry FaultProgram "
+            "lane segments — use the sharded ring engine")
+    return base
 
 
 def _reject_join_plans(plan: FaultPlan) -> None:
@@ -651,6 +667,7 @@ def _reject_join_plans(plan: FaultPlan) -> None:
 def place(cfg: SwimConfig, mesh, state: RumorState, plan: FaultPlan):
     """Device-put state/plan with this engine's placement (plan and
     gone_key replicated, node-axis tensors sharded)."""
+    plan = _accept_plan(plan)
     _reject_join_plans(plan)
     from jax.sharding import NamedSharding
 

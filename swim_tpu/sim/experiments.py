@@ -42,8 +42,8 @@ def pick_engine(n: int, engine: str = "auto") -> str:
 
 
 @functools.lru_cache(maxsize=16)
-def _mapped_step(cfg: SwimConfig, mesh):
-    """Identity-stable sharded step per (cfg, mesh).
+def _mapped_step(cfg: SwimConfig, mesh, program: bool = False):
+    """Identity-stable sharded step per (cfg, mesh, program-plan flag).
 
     `run_study_ring` is jitted with `step_fn` as a STATIC argument, so
     its compile cache is keyed on the function object's identity — a
@@ -55,7 +55,7 @@ def _mapped_step(cfg: SwimConfig, mesh):
     """
     from swim_tpu.parallel import ring_shard
 
-    return ring_shard.mapped_step(cfg, mesh)
+    return ring_shard.mapped_step(cfg, mesh, program)
 
 
 def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
@@ -76,8 +76,10 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
 
         state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
                                        plan)
-        return runner.run_study_ring(cfg, state, plan, key, periods,
-                                     _mapped_step(cfg, mesh))
+        return runner.run_study_ring(
+            cfg, state, plan, key, periods,
+            _mapped_step(cfg, mesh,
+                         isinstance(plan, faults.FaultProgram)))
     plan = pmesh.shard_state(plan, mesh, n=n)
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)

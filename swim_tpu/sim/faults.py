@@ -129,6 +129,165 @@ def halves(n: int) -> np.ndarray:
     return g
 
 
+# ---------------------------------------------------------------------------
+# FaultProgram: piecewise per-node link/gray fault schedules (sim/scenario.py
+# compiles declarative specs into these; the engines consume them directly)
+# ---------------------------------------------------------------------------
+
+# Segment kinds.  "crash" segments never reach the engines: the scenario
+# compiler folds them into base.crash_step at compile time, so a crash
+# schedule leaves zero runtime residue.
+KIND_NONE = 0        # inert slot (padding)
+KIND_SEND_LOSS = 1   # add to the sender-side loss threshold (all legs)
+KIND_RECV_LOSS = 2   # add to the receiver-side loss threshold (all legs)
+KIND_LINK_LOSS = 3   # symmetric: both send and receive legs
+KIND_GRAY = 4        # reply legs only: the node receives and gossips
+#                      normally but its acks get lost — Lifeguard's
+#                      gray-failure ablation workload
+
+SEG_KINDS = {
+    "send_loss": KIND_SEND_LOSS,
+    "recv_loss": KIND_RECV_LOSS,
+    "link_loss": KIND_LINK_LOSS,
+    "gray": KIND_GRAY,
+}
+
+LANE_MAX = 65535  # u16 wire ceiling for one lane (see level_to_threshold)
+
+
+class FaultProgram(NamedTuple):
+    """FaultPlan plus a compiled piecewise fault schedule.
+
+    Everything is a runtime tensor: sweeps over scenarios with the same
+    segment COUNT reuse one compiled step, exactly like FaultPlan.  The
+    segment arrays have static length S (the trace axis); scenario
+    compilation pads to a fixed capacity so a library of specs shares
+    one trace.  S == 0 means "no program": `split_program` strips the
+    wrapper and the engines run the plain-FaultPlan code path, which is
+    what makes the empty scenario bitwise-identical to `none(n)`.
+
+    Per-node lanes derived from the segments are u16 thresholds in the
+    same integer geometry as the engines' loss legs (`bits >= thr` with
+    thr = ceil(p * 65536)): they compose with the global loss threshold
+    by saturating addition.  A single u16 lane saturates at 65535 —
+    probability 65535/65536, not quite 1.0; "never deliver" needs the
+    composed threshold (loss + lane) to reach 65536, or a crash/
+    partition segment.
+    """
+
+    base: FaultPlan
+    domain_id: jax.Array   # u8[N] failure-domain labels (racks)
+    seg_start: jax.Array   # i32[S] first period (inclusive)
+    seg_end: jax.Array     # i32[S] last period (exclusive)
+    seg_period: jax.Array  # i32[S] flap cycle length, 0 = always active
+    seg_on: jax.Array      # i32[S] on-duty periods per cycle
+    seg_domain: jax.Array  # i32[S] target domain, -1 = every node
+    seg_kind: jax.Array    # i32[S] KIND_* selector
+    seg_level: jax.Array   # u32[S] u16 threshold = level_to_threshold(p)
+
+
+def level_to_threshold(p: float) -> int:
+    """Probability -> u16 lane threshold, matching the engines' integer
+    loss geometry (ceil(p * 65536), clamped to the u16 wire)."""
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"fault level must be in [0, 1]: got {p}")
+    return min(int(np.ceil(p * 65536.0)), LANE_MAX)
+
+
+def empty_program(n: int) -> FaultProgram:
+    """A FaultProgram with zero segments wrapping a perfect network."""
+    return as_program(none(n))
+
+
+def as_program(plan: FaultPlan, domain_id=None,
+               capacity: int = 0) -> FaultProgram:
+    """Wrap a FaultPlan with `capacity` inert segment slots."""
+    n = plan.crash_step.shape[0]
+    if domain_id is None:
+        dom = jnp.zeros((n,), jnp.uint8)
+    else:
+        dom = jnp.asarray(domain_id, jnp.uint8)
+    s = int(capacity)
+    zi = jnp.zeros((s,), jnp.int32)
+    return FaultProgram(
+        base=plan, domain_id=dom,
+        seg_start=zi, seg_end=zi, seg_period=zi, seg_on=zi,
+        seg_domain=jnp.full((s,), -1, jnp.int32), seg_kind=zi,
+        seg_level=jnp.zeros((s,), jnp.uint32))
+
+
+def with_segment(prog: FaultProgram, slot: int, *, start: int, end: int,
+                 kind: str, level: float, domain: int = -1,
+                 period: int = 0, on: int = 0) -> FaultProgram:
+    """Fill one segment slot (host-side builder; scenario.py compiles
+    whole specs, this is the single-slot primitive under it)."""
+    if kind not in SEG_KINDS:
+        raise ValueError(
+            f"unknown segment kind {kind!r}; one of {sorted(SEG_KINDS)}")
+    if period > 0 and not 0 < on <= period:
+        raise ValueError(
+            f"flap duty must satisfy 0 < on <= period: {on}/{period}")
+    return prog._replace(
+        seg_start=prog.seg_start.at[slot].set(jnp.int32(start)),
+        seg_end=prog.seg_end.at[slot].set(jnp.int32(end)),
+        seg_period=prog.seg_period.at[slot].set(jnp.int32(period)),
+        seg_on=prog.seg_on.at[slot].set(jnp.int32(on)),
+        seg_domain=prog.seg_domain.at[slot].set(jnp.int32(domain)),
+        seg_kind=prog.seg_kind.at[slot].set(
+            jnp.int32(SEG_KINDS[kind])),
+        seg_level=prog.seg_level.at[slot].set(
+            jnp.uint32(level_to_threshold(level))))
+
+
+def split_program(plan) -> tuple[FaultPlan, FaultProgram | None]:
+    """(base plan, program-or-None).  None when the plan is a plain
+    FaultPlan or a FaultProgram with zero segments — the engines gate
+    every lane computation on this, so an empty program traces to the
+    exact graph a plain FaultPlan does (the bitwise-parity contract)."""
+    if isinstance(plan, FaultProgram):
+        if plan.seg_kind.shape[0] == 0:
+            return plan.base, None
+        return plan.base, plan
+    return plan, None
+
+
+def base_of(plan) -> FaultPlan:
+    return plan.base if isinstance(plan, FaultProgram) else plan
+
+
+def link_lanes(prog: FaultProgram, step):
+    """Per-node (send_thr, recv_thr, reply_thr) u32[N] lanes at period
+    `step`: a static unroll over the S segments (S is tiny — the trace
+    cost is a few fused selects), each segment contributing its level
+    to the nodes in its domain while its time window and flap duty are
+    active.  Values saturate at the u16 wire ceiling so the lanes can
+    ride the packed scalar wire losslessly."""
+    n = prog.domain_id.shape[0]
+    t = jnp.asarray(step, jnp.int32)
+    dom = prog.domain_id.astype(jnp.int32)
+    send = jnp.zeros((n,), jnp.uint32)
+    recv = jnp.zeros((n,), jnp.uint32)
+    reply = jnp.zeros((n,), jnp.uint32)
+    for i in range(int(prog.seg_kind.shape[0])):
+        kind = prog.seg_kind[i]
+        in_window = (t >= prog.seg_start[i]) & (t < prog.seg_end[i])
+        phase = (t - prog.seg_start[i]) % jnp.maximum(prog.seg_period[i], 1)
+        duty = (prog.seg_period[i] == 0) | (phase < prog.seg_on[i])
+        hit = (prog.seg_domain[i] < 0) | (dom == prog.seg_domain[i])
+        amt = jnp.where(in_window & duty & hit,
+                        prog.seg_level[i], jnp.uint32(0))
+        send = send + jnp.where(
+            (kind == KIND_SEND_LOSS) | (kind == KIND_LINK_LOSS),
+            amt, jnp.uint32(0))
+        recv = recv + jnp.where(
+            (kind == KIND_RECV_LOSS) | (kind == KIND_LINK_LOSS),
+            amt, jnp.uint32(0))
+        reply = reply + jnp.where(kind == KIND_GRAY, amt, jnp.uint32(0))
+    cap = jnp.uint32(LANE_MAX)
+    return (jnp.minimum(send, cap), jnp.minimum(recv, cap),
+            jnp.minimum(reply, cap))
+
+
 def crashed_mask(plan: FaultPlan, step) -> jax.Array:
     """bool[N]: which nodes have crash-stopped by period `step`."""
     return jnp.asarray(step, jnp.int32) >= plan.crash_step

@@ -31,6 +31,7 @@ from swim_tpu.config import SwimConfig
 from swim_tpu.models import dense
 from swim_tpu.obs.engine import frame_from_tap
 from swim_tpu.ops import lattice
+from swim_tpu.sim import faults
 from swim_tpu.sim.faults import FaultPlan
 from swim_tpu.utils.prng import draw_period
 
@@ -106,8 +107,9 @@ def run_study(cfg: SwimConfig, state: dense.DenseState, plan: FaultPlan,
         # metrics observe the post-step state at time st.step - 1 = the
         # period just executed
         t = st.step - 1
-        crashed = t >= plan.crash_step
-        live = ~crashed & (t >= plan.join_step)
+        base_plan = faults.base_of(plan)
+        crashed = t >= base_plan.crash_step
+        live = ~crashed & (t >= base_plan.join_step)
         track = _update_track(track, st, crashed, t, live=live)
         live_col = live[:, None]
         live_row = live[None, :]
@@ -217,8 +219,9 @@ def run_study_rumor(cfg: SwimConfig, state, plan: FaultPlan,
         else:
             st = step_fn(st, plan, rnd)
         t = st.step - 1
-        crashed = t >= plan.crash_step
-        up = ~crashed & (t >= plan.join_step)
+        base_plan = faults.base_of(plan)
+        crashed = t >= base_plan.crash_step
+        up = ~crashed & (t >= base_plan.join_step)
         not_alive, dead_seen, dead_all, counts = _rumor_subject_flags(
             cfg, st, up)
 
@@ -301,8 +304,9 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
         else:
             st = step_fn(st, plan, rnd)
         t = st.step - 1
-        crashed = t >= plan.crash_step
-        up = ~crashed & (t >= plan.join_step)
+        base_plan = faults.base_of(plan)
+        crashed = t >= base_plan.crash_step
+        up = ~crashed & (t >= base_plan.join_step)
 
         # per-slot live-knower counts (layout resolution owned by
         # ring.live_knower_counts — chunked so the bit-plane expansion
@@ -345,7 +349,7 @@ def study_milestones(result: StudyResult, plan: FaultPlan,
     the detection-summary inputs, in the shape the flight-recorder dump
     header embeds (obs/analyze.py recomputes the summary from these
     offline; milestone keys name the summary's output prefixes)."""
-    crash = np.asarray(plan.crash_step)
+    crash = np.asarray(faults.base_of(plan).crash_step)
     crashed = crash < periods
     milestones = {
         name: np.asarray(arr)[crashed].astype(np.int64)

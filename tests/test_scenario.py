@@ -1,0 +1,426 @@
+"""Scenario compiler: spec -> tensor fault programs, gated by the observatory.
+
+Four contracts from the scenario subsystem (sim/scenario.py):
+
+  1. COMPILATION is golden: domain labelling forms, crash folding into
+     base.crash_step with zero runtime residue, segment tensor values in
+     the engines' integer loss geometry, validation rejects bad specs.
+  2. The EMPTY scenario is BITWISE identical to faults.none(n) on every
+     engine — dense, rumor, ring — and through the sharded ring's
+     program-aware step (S == 0 strips the wrapper; inert capacity slots
+     contribute exactly zero to every threshold).
+  3. The GRAY ablation separates: with reply-loss (node alive, gossips,
+     misses acks) LHA + buddy holds strictly fewer false-dead views than
+     vanilla SWIM at the library's calibrated level.
+  4. Adversarial DELIVERY is idempotent on the real-node path: the same
+     datagram decoded twice leaves membership unchanged; a cluster under
+     duplication + stale-incarnation replay stays clean (no decode
+     errors, no false-dead views).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig, Status
+from swim_tpu.models import dense, ring, rumor
+from swim_tpu.parallel import mesh as pmesh, ring_shard
+from swim_tpu.sim import faults, scenario
+from swim_tpu.utils.prng import draw_period
+
+
+def sc(**kw):
+    kw.setdefault("name", "t")
+    return scenario.Scenario(**kw)
+
+
+# ---------------------------------------------------------------------------
+# 1. Compilation
+# ---------------------------------------------------------------------------
+
+
+class TestDomainLabels:
+    def test_blocks(self):
+        lab = scenario.domain_labels(8, "blocks:4")
+        np.testing.assert_array_equal(lab, [0, 0, 1, 1, 2, 2, 3, 3])
+        assert lab.dtype == np.uint8
+
+    def test_blocks_uneven(self):
+        # ceil-div block size: 10 nodes / 4 racks -> blocks of 3
+        lab = scenario.domain_labels(10, "blocks:4")
+        np.testing.assert_array_equal(lab, [0, 0, 0, 1, 1, 1, 2, 2, 2, 3])
+
+    def test_stripe(self):
+        lab = scenario.domain_labels(8, "stripe:3")
+        np.testing.assert_array_equal(lab, [0, 1, 2, 0, 1, 2, 0, 1])
+
+    def test_explicit(self):
+        lab = scenario.domain_labels(4, [3, 1, 0, 3])
+        np.testing.assert_array_equal(lab, [3, 1, 0, 3])
+        assert lab.dtype == np.uint8
+
+    def test_none_is_single_domain(self):
+        assert scenario.domain_labels(5, None).max() == 0
+
+    @pytest.mark.parametrize("bad", ["blocks:0", "blocks:257", "racks:4",
+                                     "blocks:x"])
+    def test_bad_string_specs(self, bad):
+        with pytest.raises(ValueError):
+            scenario.domain_labels(8, bad)
+
+    def test_explicit_wrong_shape_or_range(self):
+        with pytest.raises(ValueError):
+            scenario.domain_labels(4, [0, 1])
+        with pytest.raises(ValueError):
+            scenario.domain_labels(2, [0, 300])
+
+
+class TestCompile:
+    def test_level_threshold_geometry(self):
+        # matches the engines' integer loss legs: thr = ceil(p * 65536),
+        # saturated at the u16 wire
+        assert faults.level_to_threshold(0.0) == 0
+        assert faults.level_to_threshold(0.3) == 19661
+        assert faults.level_to_threshold(1.0) == 65535
+
+    def test_golden_flap_segment(self):
+        spec = sc(n=8, periods=20, domains="blocks:4",
+                  events=[{"kind": "link_loss", "start": 4, "end": 16,
+                           "level": 0.2, "domain": 2, "period": 6,
+                           "on": 3}])
+        prog = scenario.compile_program(spec)
+        assert int(prog.seg_kind.shape[0]) == 1
+        assert int(prog.seg_start[0]) == 4
+        assert int(prog.seg_end[0]) == 16
+        assert int(prog.seg_period[0]) == 6
+        assert int(prog.seg_on[0]) == 3
+        assert int(prog.seg_domain[0]) == 2
+        assert int(prog.seg_kind[0]) == faults.KIND_LINK_LOSS
+        assert int(prog.seg_level[0]) == faults.level_to_threshold(0.2)
+        np.testing.assert_array_equal(np.asarray(prog.domain_id),
+                                      [0, 0, 1, 1, 2, 2, 3, 3])
+
+    def test_crash_event_folds_with_no_runtime_residue(self):
+        # a whole-domain crash compiles into base.crash_step; it must
+        # NOT occupy a segment slot (S stays 0 -> empty-parity path)
+        spec = sc(n=8, periods=20, domains="blocks:4",
+                  events=[{"kind": "crash", "start": 12, "domain": 1}])
+        prog = scenario.compile_program(spec)
+        assert int(prog.seg_kind.shape[0]) == 0
+        cs = np.asarray(prog.base.crash_step)
+        np.testing.assert_array_equal(cs[2:4], [12, 12])
+        assert (cs[[0, 1, 4, 5, 6, 7]] > 10**6).all()
+
+    def test_crash_nodes_and_loss_compose(self):
+        spec = sc(n=6, periods=10, loss=0.25,
+                  events=[{"kind": "crash", "start": 3, "nodes": [1, 4]}])
+        prog = scenario.compile_program(spec)
+        ref = faults.with_crashes(faults.with_loss(faults.none(6), 0.25),
+                                  np.array([1, 4], np.int32), 3)
+        for f in ref._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(prog.base, f)),
+                np.asarray(getattr(ref, f)), err_msg=f)
+
+    def test_capacity_pads_with_inert_slots(self):
+        spec = sc(n=4, periods=10, capacity=3,
+                  events=[{"kind": "gray", "start": 1, "end": 5,
+                           "level": 0.5}])
+        prog = scenario.compile_program(spec)
+        assert int(prog.seg_kind.shape[0]) == 3
+        np.testing.assert_array_equal(np.asarray(prog.seg_kind), [4, 0, 0])
+        # inert slots: empty window, zero level -> zero lane contribution
+        np.testing.assert_array_equal(np.asarray(prog.seg_end)[1:], [0, 0])
+        np.testing.assert_array_equal(np.asarray(prog.seg_level)[1:],
+                                      [0, 0])
+
+    def test_capacity_overflow_rejected(self):
+        spec = sc(n=4, periods=10, capacity=0,
+                  events=[{"kind": "gray", "start": 1, "end": 5,
+                           "level": 0.5}])
+        with pytest.raises(ValueError, match="capacity"):
+            scenario.compile_program(spec)
+
+    @pytest.mark.parametrize("ev,msg", [
+        ({"kind": "melt", "start": 1, "end": 2, "level": 0.1},
+         "unknown kind"),
+        ({"kind": "gray", "start": 5, "end": 5, "level": 0.1},
+         "end > start"),
+        ({"kind": "gray", "start": 1, "end": 2, "level": 1.5},
+         "level in"),
+        ({"kind": "gray", "start": 1, "end": 9, "level": 0.1,
+          "period": 4, "on": 5}, "flap duty"),
+        ({"kind": "gray", "start": 1, "end": 9, "level": 0.1,
+          "domain": 7}, "out of range"),
+        ({"kind": "gray", "start": 1, "end": 9, "level": 0.1,
+          "colour": 3}, "unknown key"),
+        ({"kind": "crash", "start": 1, "domain": 0, "nodes": [0]},
+         "either"),
+    ])
+    def test_validation_rejects(self, ev, msg):
+        spec = sc(n=8, periods=12, domains="blocks:2", events=[ev])
+        with pytest.raises(ValueError, match=msg):
+            scenario.validate(spec)
+
+    def test_validate_engine_and_arm_keys(self):
+        with pytest.raises(ValueError, match="unknown engine"):
+            scenario.validate(sc(engine="abacus"))
+        with pytest.raises(ValueError, match="unknown key"):
+            scenario.validate(sc(arms={"a": {"turbo": True}}))
+
+    def test_fault_gauges_duty_cycle(self):
+        spec = sc(n=8, periods=12, domains="blocks:4",
+                  events=[{"kind": "gray", "start": 2, "end": 10,
+                           "level": 0.5, "domain": 1, "period": 4,
+                           "on": 2}])
+        g = scenario.fault_gauges(spec)
+        # duty (t-2) % 4 < 2 inside [2, 10): active at t = 2,3,6,7
+        np.testing.assert_array_equal(
+            g["gray_nodes"],
+            [0, 0, 2, 2, 0, 0, 2, 2, 0, 0, 0, 0])
+        # flap gauge counts the whole flapping window, duty-independent
+        np.testing.assert_array_equal(
+            g["flap_active"],
+            [0, 0, 2, 2, 2, 2, 2, 2, 2, 2, 0, 0])
+
+    def test_library_specs_validate_and_compile(self):
+        for name, spec in scenario.LIBRARY.items():
+            scenario.validate(spec)
+            if spec.study is None and spec.engine != "real" \
+                    and spec.n <= 4096:
+                prog = scenario.compile_program(spec)
+                assert isinstance(prog, faults.FaultProgram), name
+
+    def test_get_aliases_hyphens(self):
+        assert scenario.get("gray-10pct") is scenario.LIBRARY["gray_10pct"]
+        with pytest.raises(KeyError):
+            scenario.get("no-such-scenario")
+
+
+# ---------------------------------------------------------------------------
+# 2. Empty-scenario bitwise parity
+# ---------------------------------------------------------------------------
+
+
+def assert_states_equal(a, b, msg=""):
+    for f in a._fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"{msg}:{f}")
+
+
+class TestEmptyScenarioParity:
+    """An eventless scenario compiles to S == 0; split_program strips the
+    wrapper, so the engines trace the exact plain-FaultPlan graph —
+    parity is structural, checked here bitwise over live periods."""
+
+    N, T = 32, 6
+
+    def _prog(self, engine):
+        spec = sc(n=self.N, periods=self.T, engine=engine, loss=0.1,
+                  crashes={"fraction": 0.1, "start": 2, "end": 4})
+        return scenario.compile_program(spec)
+
+    def _plain(self):
+        plan = faults.with_loss(faults.none(self.N), 0.1)
+        return faults.with_random_crashes(plan, jax.random.key(1), 0.1,
+                                          2, 4)
+
+    def test_program_base_matches_plain_plan(self):
+        prog = self._prog("ring")
+        plain = self._plain()
+        for f in plain._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(prog.base, f)),
+                np.asarray(getattr(plain, f)), err_msg=f)
+        base, residue = faults.split_program(prog)
+        assert residue is None
+
+    def test_dense_bitwise(self):
+        cfg = SwimConfig(n_nodes=self.N)
+        self._run_pair(cfg, dense, lambda k, t, c: draw_period(k, t, c))
+
+    def test_rumor_bitwise(self):
+        cfg = SwimConfig(n_nodes=self.N)
+        self._run_pair(cfg, rumor, rumor.draw_period_rumor)
+
+    def test_ring_bitwise(self):
+        cfg = SwimConfig(n_nodes=self.N, lifeguard=True, buddy=True)
+        self._run_pair(cfg, ring, ring.draw_period_ring)
+
+    def _run_pair(self, cfg, eng, draw):
+        plan, prog = self._plain(), self._prog("ring")
+        key = jax.random.key(3)
+        step = jax.jit(lambda s, p, r: eng.step(cfg, s, p, r))
+        s_plan, s_prog = eng.init_state(cfg), eng.init_state(cfg)
+        for t in range(self.T):
+            rnd = draw(key, t, cfg)
+            s_plan = step(s_plan, plan, rnd)
+            s_prog = step(s_prog, prog, rnd)
+            assert_states_equal(s_plan, s_prog,
+                                f"{eng.__name__} @ {t}")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual mesh")
+class TestShardedProgramParity:
+    """Tri-run on the 8-device mesh, shrunken geometry (compile cost):
+    global plain plan vs the sharded program-aware step with (a) an
+    inert-capacity program (zero lanes — bitwise the baseline) and (b)
+    an ACTIVE link_loss program, checked against the global engine
+    running the same program.  One compile serves both program arms
+    (same capacity -> same trace)."""
+
+    def test_tri_run(self):
+        n, periods = 32, 5
+        cfg = SwimConfig(n_nodes=n, suspicion_mult=1.0, k_indirect=1,
+                         max_piggyback=2, ring_window_periods=2,
+                         ring_view_c=2, ring_probe="rotor",
+                         ring_sel_scope="period",
+                         ring_scalar_wire="packed", lifeguard=True,
+                         buddy=True)
+        dom = scenario.domain_labels(n, "blocks:4")
+        inert = scenario.compile_program(
+            sc(n=n, periods=periods, domains="blocks:4", capacity=1))
+        active = scenario.compile_program(
+            sc(n=n, periods=periods, domains="blocks:4", capacity=1,
+               events=[{"kind": "link_loss", "start": 1, "end": 4,
+                        "level": 0.4, "domain": 2}]))
+        np.testing.assert_array_equal(np.asarray(inert.domain_id), dom)
+
+        mesh = pmesh.make_mesh(8)
+        sh_step = ring_shard.build_step(cfg, mesh, program=True)
+        arms = {}
+        for label, prog in (("inert", inert), ("active", active)):
+            st, pl = ring_shard.place(cfg, mesh, ring.init_state(cfg),
+                                      prog)
+            arms[label] = {"state": st, "plan": pl}
+        g_step = jax.jit(lambda s, p, r: ring.step(cfg, s, p, r))
+        g_plain = ring.init_state(cfg)
+        g_active = ring.init_state(cfg)
+        plain = faults.none(n)
+        key = jax.random.key(11)
+        for t in range(periods):
+            rnd = ring.draw_period_ring(key, t, cfg)
+            g_plain = g_step(g_plain, plain, rnd)
+            g_active = g_step(g_active, active, rnd)
+            for label, ref in (("inert", g_plain), ("active", g_active)):
+                arm = arms[label]
+                out = sh_step(arm["state"], arm["plan"], rnd)
+                arm["state"] = out[0] if type(out) is tuple else out
+                assert_states_equal(ref, arm["state"],
+                                    f"sharded {label} @ {t}")
+        # the active program must actually have bitten: its loss window
+        # changes state vs the clean baseline
+        diff = any(
+            not np.array_equal(np.asarray(getattr(g_plain, f)),
+                               np.asarray(getattr(g_active, f)))
+            for f in g_plain._fields)
+        assert diff, "active link_loss program changed nothing"
+
+
+# ---------------------------------------------------------------------------
+# 3. Gray-failure ablation (library scenario, calibrated)
+# ---------------------------------------------------------------------------
+
+
+class TestGrayAblation:
+    def test_lha_strictly_beats_vanilla(self, tmp_path):
+        verdict, path = scenario.run(scenario.get("gray-10pct"),
+                                     out_dir=str(tmp_path))
+        assert verdict["verdict"] == "pass", verdict["checks"]
+        lha = verdict["arms"]["lha"]
+        vanilla = verdict["arms"]["vanilla"]
+        # reply-loss separates the geometries: vanilla misreads missing
+        # acks as death; LHA + buddy refutes before expiry
+        assert vanilla["false_dead_views_peak"] > 0
+        assert lha["false_dead_views_peak"] \
+            < vanilla["false_dead_views_peak"]
+        assert lha["false_dead_views_final"] == 0
+        # the gray lane is priced on the packed scalar wire
+        assert lha["ici"]["roll_link_thr_bytes"] > 0
+        with open(path) as fh:
+            on_disk = json.load(fh)
+        assert on_disk["kind"] == scenario.VERDICT_KIND
+
+
+# ---------------------------------------------------------------------------
+# 4. Duplication / stale-replay idempotence on the real-node path
+# ---------------------------------------------------------------------------
+
+
+def _member_snapshot(node, n):
+    return {m: (op.status, op.incarnation)
+            for m in range(n)
+            if (op := node.members.opinion(m)) is not None}
+
+
+class TestReplayIdempotence:
+    def test_decode_same_datagram_twice_is_noop(self):
+        from swim_tpu.core.cluster import SimCluster
+        from swim_tpu.core.codec import Message, MsgKind, WireUpdate, \
+            encode
+
+        n = 8
+        cfg = SwimConfig(n_nodes=n, k_indirect=2, protocol_period=1.0)
+        c = SimCluster(cfg, seed=5)
+        c.start()
+        c.run(3.0)
+        node = c.nodes[0]
+        src = node.members.addr(1)
+        # a stale-incarnation ALIVE claim about a known peer, plus a
+        # duplicate-delivered ACK envelope
+        payload = encode(Message(
+            kind=MsgKind.ACK, sender=1, probe_seq=0,
+            gossip=(WireUpdate(2, Status.ALIVE, 0,
+                               node.members.addr(2), origin=1),)))
+        node._on_datagram(src, payload)
+        first = _member_snapshot(node, n)
+        node._on_datagram(src, payload)
+        assert _member_snapshot(node, n) == first
+        assert node.stats["decode_errors"] == 0
+
+    def test_replay_storm_scenario_stays_clean(self, tmp_path):
+        verdict, _ = scenario.run(scenario.get("replay-storm"),
+                                  out_dir=str(tmp_path))
+        assert verdict["verdict"] == "pass", verdict["checks"]
+        real = verdict["arms"]["real"]
+        # the adversarial deliveries actually happened...
+        assert real["network"]["duplicated"] > 0
+        assert real["network"]["replayed"] > 0
+        # ...and the protocol shrugged: decode is idempotent, stale
+        # incarnations lose the lattice merge
+        assert "decode_errors" in real["counters"]
+        assert real["counters"]["decode_errors"] == 0
+        assert real["false_dead_views_final"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Verdict artifacts + CLI
+# ---------------------------------------------------------------------------
+
+
+class TestVerdictArtifact:
+    def test_rerun_is_byte_identical(self, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        _, p1 = scenario.run(scenario.get("replay-storm"),
+                             out_dir=str(a))
+        _, p2 = scenario.run(scenario.get("replay-storm"),
+                             out_dir=str(b))
+        assert open(p1, "rb").read() == open(p2, "rb").read()
+
+    def test_cli_list_and_show(self, capsys):
+        from swim_tpu import cli
+
+        assert cli.main(["scenario", "list"]) == 0
+        out = capsys.readouterr().out
+        for name in scenario.LIBRARY:
+            assert name in out
+        assert cli.main(["scenario", "show", "gray-10pct"]) == 0
+        shown = json.loads(capsys.readouterr().out)
+        assert shown["n"] == 256

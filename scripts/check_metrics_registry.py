@@ -173,6 +173,79 @@ def check_scenario_rules() -> list[str]:
     return problems
 
 
+def check_scenario_metrics() -> list[str]:
+    """Problems with the scenario expect-metric surface ([] = clean).
+
+    Engine-arm checks (`metric_zero` / `metric_max` / `metric_nonzero`
+    / `fewer`) look their metric up in the arm digest dict
+    (sim/scenario.py `_arm_digest`); a typo'd or renamed metric reads
+    as None, which `metric_zero` treats as failing but `fewer` would
+    compare as None-vs-None.  Pin every library metric name to the
+    digest vocabulary, derived from the same sources the digest is
+    built from (PeriodSeries fields x series_digest suffixes, the
+    detection-summary milestone keys, and _arm_digest's explicit
+    scalars) so a telemetry-field rename surfaces at build time.
+    """
+    from swim_tpu.sim import scenario
+    from swim_tpu.sim.runner import PeriodSeries
+
+    vocab = {f"{f}_{s}" for f in PeriodSeries._fields
+             for s in ("final", "peak", "sum", "mean")}
+    for m in ("suspect", "dead_view", "disseminated"):
+        vocab |= {f"{m}_detected", f"{m}_latency_mean",
+                  f"{m}_latency_p50", f"{m}_latency_p99"}
+    vocab |= {"crashed", "overflow", "max_incarnation",
+              "false_dead_views_final", "false_dead_views_peak"}
+    metric_checks = ("metric_zero", "metric_max", "metric_nonzero",
+                     "fewer")
+    problems: list[str] = []
+    for name, spec in scenario.LIBRARY.items():
+        if spec.engine == "real":
+            continue   # real arms digest counters, not engine series
+        for chk in spec.expect:
+            if chk.get("check") not in metric_checks:
+                continue
+            metric = chk.get("metric")
+            if metric is not None and metric not in vocab:
+                problems.append(
+                    f"library scenario {name!r} checks unknown metric "
+                    f"{metric!r} — not in the engine-arm digest "
+                    "vocabulary")
+    return problems
+
+
+def check_trend_tier_keys() -> list[str]:
+    """Problems with the bench->trend key surface ([] = clean).
+
+    The trend engine (obs/trend.py) auto-registers a tier series only
+    when a bench payload carries BOTH `<tier>_periods_per_sec` and
+    `<tier>_nodes`; a tier that emits one without the other silently
+    never trends.  For the special-cased artifact tiers (which bypass
+    the generic `{tier}_{key}` loop in bench.py main()), scan bench.py
+    source for explicitly written key literals and require the pair.
+    """
+    import re
+
+    bench_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    with open(bench_py) as f:
+        src = f.read()
+    pps = set(re.findall(r'"([a-z0-9]+)_periods_per_sec"', src))
+    nodes = set(re.findall(r'"([a-z0-9]+)_nodes"', src))
+    problems: list[str] = []
+    for tier in sorted(pps - nodes):
+        problems.append(
+            f"bench.py writes \"{tier}_periods_per_sec\" but never "
+            f"\"{tier}_nodes\" — the trend engine needs both to "
+            "register the series")
+    for tier in sorted(nodes - pps):
+        problems.append(
+            f"bench.py writes \"{tier}_nodes\" but never "
+            f"\"{tier}_periods_per_sec\" — the trend engine needs both "
+            "to register the series")
+    return problems
+
+
 def main() -> int:
     from swim_tpu.obs.registry import NODE_COUNTERS
 
@@ -206,6 +279,12 @@ def main() -> int:
     for problem in scenario_problems:
         ok = False
         print(f"scenario-rule lint: {problem}", file=sys.stderr)
+    for problem in check_scenario_metrics():
+        ok = False
+        print(f"scenario-metric lint: {problem}", file=sys.stderr)
+    for problem in check_trend_tier_keys():
+        ok = False
+        print(f"trend-key lint: {problem}", file=sys.stderr)
     from swim_tpu.obs.health import HEALTH_RULES
     from swim_tpu.obs.prof import PROF_GAUGES
     from swim_tpu.sim.scenario import LIBRARY

@@ -77,11 +77,18 @@ FAST_FILES = (
 # plus the spec's own expectations.  baseline_config3 (n=100k, 4 arms)
 # is library-only, far too heavy for CI.
 FAST_SCENARIOS = (
-    "rack_outage",
-    "flap",
-    "gray_10pct",
-    "replay_storm",
-    "lean_fidelity",
+    # (label, scenario name, extra CLI flags).  flap runs twice: once
+    # through the serial arm loop and once through the vmapped
+    # program-batch path (--batch) — the batched run must produce the
+    # same passing verdict (identical artifact bytes modulo nothing:
+    # same out_dir), so a batching regression fails CI by name.
+    ("rack_outage", "rack_outage", ()),
+    ("flap", "flap", ()),
+    ("flap@batch", "flap", ("--batch",)),
+    ("flap_boundary", "flap_boundary", ()),
+    ("gray_10pct", "gray_10pct", ()),
+    ("replay_storm", "replay_storm", ()),
+    ("lean_fidelity", "lean_fidelity", ()),
 )
 
 
@@ -92,11 +99,11 @@ def run_scenarios(out_dir: str, timeout: float, env: dict) -> list[str]:
     sweep that follows also replays the scenario telemetry."""
     failures: list[str] = []
     os.makedirs(out_dir, exist_ok=True)
-    for name in FAST_SCENARIOS:
+    for label, name, flags in FAST_SCENARIOS:
         t0 = time.time()
         p = subprocess.Popen(
             [sys.executable, "-m", "swim_tpu.cli", "scenario", "run",
-             name, "--check", "--out-dir", out_dir],
+             name, "--check", "--out-dir", out_dir, *flags],
             cwd=REPO, env=env, text=True, start_new_session=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         try:
@@ -110,11 +117,11 @@ def run_scenarios(out_dir: str, timeout: float, env: dict) -> list[str]:
             out, rc = f"TIMEOUT after {timeout:.0f}s", None
         dt = time.time() - t0
         mark = "PASS" if rc == 0 else "FAIL"
-        print(f"{mark} scenario:{name:32s} {dt:7.1f}s", flush=True)
+        print(f"{mark} scenario:{label:32s} {dt:7.1f}s", flush=True)
         if rc != 0:
             for line in (out or "").strip().splitlines()[-10:]:
                 print(f"  {line}", flush=True)
-            failures.append(f"scenario:{name}")
+            failures.append(f"scenario:{label}")
     return failures
 
 

@@ -185,6 +185,16 @@ CAPTURES: list = [
      ["bench.py", "--tier", "ringshardc", "--nodes", "1000000",
       "--periods", "50", "--tier-timeout", "1500"], 1800, False,
      _bench_on_tpu),
+    # Batched scenario fleet on the real chip: the CPU host measures
+    # ~1x wall-clock for the vmapped fleet (XLA-CPU gather/scatter does
+    # not amortize across the batch axis — bench_results/
+    # scenariobatch_fleet.json is the honest stand-in), so the
+    # hardware wall-clock ratio is captured here.  Parity gates inside
+    # the tier: a run whose batched lanes diverge from serial reports
+    # ok=false and value 0 and is not recorded as a capture.
+    ("scenariobatch",
+     ["bench.py", "--tier", "scenariobatch", "--tier-timeout", "1500"],
+     1800, False, _bench_on_tpu),
     # Detection law beyond the XLA-CPU envelope (which aborts at 8M):
     # pull-probe ring engine at 10M on real hardware.  The flight-record
     # dump lets _attach_analysis enrich the capture with the offline

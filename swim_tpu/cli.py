@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -444,6 +445,30 @@ def _cmd_scenario(args: argparse.Namespace) -> int:
             for n, m, nn, d in rows:
                 print(f"{n:<{w}}  {m:<9} n={nn:<7} {d}")
         return 0
+    if args.action == "search":
+        from swim_tpu.sim import search as scenario_search
+
+        out = os.path.join(args.out_dir, "scenario_search_boundary.json")
+        report = scenario_search.search(
+            generations=args.generations, pop=args.pop, seed=args.seed,
+            out=out)
+        if args.json:
+            print(json.dumps(report, indent=1, sort_keys=True,
+                             default=str))
+        else:
+            b = report["boundary"]
+            viols = report["explore"]["violations"]
+            print(f"search: evaluated "
+                  f"{report['explore']['evaluated']} candidates, "
+                  f"{len(report['explore']['archive'])} behavior cells, "
+                  f"{len(viols)} violation hits -> {out}")
+            if b.get("found"):
+                print(f"  flap false-dead boundary: clean at level "
+                      f"{b['clean_level']}, violating at "
+                      f"{b['violation_level']} (width {b['width']})")
+        if args.check and not report["boundary"].get("found"):
+            return 1
+        return 0
     if args.name is None:
         print("scenario show/run need a scenario name "
               f"(one of {sorted(scenario.LIBRARY)})", file=sys.stderr)
@@ -453,7 +478,8 @@ def _cmd_scenario(args: argparse.Namespace) -> int:
         scenario.validate(sc)
         print(json.dumps(sc.spec_dict(), indent=1, sort_keys=True))
         return 0
-    verdict, path = scenario.run(sc, out_dir=args.out_dir)
+    verdict, path = scenario.run(sc, out_dir=args.out_dir,
+                                 batch=args.batch)
     if args.json:
         print(json.dumps(verdict, indent=1, sort_keys=True,
                          default=str))
@@ -590,11 +616,11 @@ def build_parser() -> argparse.ArgumentParser:
         "scenario", help="compile & run adversarial fault scenarios "
                          "(sim/scenario.py library) gated by the "
                          "observatory")
-    sc.add_argument("action", choices=("list", "show", "run"))
+    sc.add_argument("action", choices=("list", "show", "run", "search"))
     sc.add_argument("name", nargs="?", default=None,
                     help="library scenario name (hyphens ok: "
-                         "rack-outage, flap, gray-10pct, replay-storm, "
-                         "baseline-config3, lean-fidelity)")
+                         "rack-outage, flap, flap-boundary, gray-10pct, "
+                         "replay-storm, baseline-config3, lean-fidelity)")
     sc.add_argument("--out-dir", default="bench_results",
                     help="where verdict artifacts + telemetry dumps go")
     sc.add_argument("--json", action="store_true",
@@ -602,6 +628,16 @@ def build_parser() -> argparse.ArgumentParser:
     sc.add_argument("--check", action="store_true",
                     help="exit 1 unless every scenario check passes "
                          "(CI gate)")
+    sc.add_argument("--batch", action="store_true",
+                    help="run the engine arms as one vmapped fleet per "
+                         "shared config (sim/faults.py ProgramBatch) — "
+                         "verdict is bitwise-identical to serial")
+    sc.add_argument("--generations", type=int, default=4,
+                    help="[search] mutation generations")
+    sc.add_argument("--pop", type=int, default=16,
+                    help="[search] candidates per vmapped generation")
+    sc.add_argument("--seed", type=int, default=0,
+                    help="[search] deterministic search seed")
     sc.set_defaults(fn=_cmd_scenario)
 
     pr = sub.add_parser(

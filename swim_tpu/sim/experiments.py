@@ -93,6 +93,72 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
     return runner.run_study_rumor(cfg, state, plan, key, periods)
 
 
+def _run_study_batch(cfg: SwimConfig, progs, keys, periods: int,
+                     engine: str, capacity: int | None = None):
+    """`len(progs)` same-config studies as ONE vmapped device run.
+
+    `progs` are FaultPrograms sharing one N; they are padded to a
+    common segment capacity (the library max, or `capacity` if larger)
+    so the batch traces a single step, then stacked along a leading P
+    axis and driven through `runner.run_study_batch`.  `keys` is one
+    root key per lane.  Every leaf of the returned StudyResult carries
+    the [P] axis; de-interleave with `runner.lane_result` — each lane
+    is bitwise-identical to its serial run (inert padding slots add
+    zero to every lane threshold).
+
+    The exchange-sharded engine has no program path (it rejects
+    FaultPrograms serially too); dense/rumor/ring vmap the raw study
+    bodies, and ringshard vmaps over the shard_map'd step closure —
+    same memoized `_mapped_step`, so batched and serial studies share
+    the sharded step cache."""
+    import jax.numpy as jnp
+
+    if engine == "shard":
+        raise ValueError("batched studies: the exchange-sharded engine "
+                         "has no fault-program path; use rumor, ring, "
+                         "or ringshard")
+    mesh = pmesh.make_mesh()
+    n = cfg.n_nodes
+    progs = list(progs)
+    cap = max(int(p.seg_kind.shape[0]) for p in progs)
+    if capacity is not None:
+        cap = max(cap, int(capacity))
+    padded = [faults.pad_program(p, cap) for p in progs]
+    root_keys = jnp.stack(list(keys))
+    if len(root_keys.shape) != 1 or root_keys.shape[0] != len(progs):
+        raise ValueError(
+            f"batched studies: {len(progs)} lanes need {len(progs)} root "
+            f"keys, got shape {root_keys.shape}")
+    if engine == "ringshard":
+        from swim_tpu.models import ring
+        from swim_tpu.parallel import ring_shard
+
+        placed = [ring_shard.place(cfg, mesh, ring.init_state(cfg), pr)
+                  for pr in padded]
+        states = runner.batch_states([s for s, _ in placed])
+        plans = runner.batch_states([pl for _, pl in placed])
+        return runner.run_study_batch(
+            cfg, states, plans, root_keys, periods, "ring",
+            _mapped_step(cfg, mesh, True))
+    plans = runner.batch_states(
+        [pmesh.shard_state(pr, mesh, n=n) for pr in padded])
+    if engine == "dense":
+        init = dense.init_state
+        kind = "dense"
+    elif engine == "ring":
+        from swim_tpu.models import ring
+
+        init = ring.init_state
+        kind = "ring"
+    else:
+        init = rumor.init_state
+        kind = "rumor"
+    states = runner.batch_states(
+        [pmesh.shard_state(init(cfg), mesh, n=n) for _ in padded])
+    return runner.run_study_batch(cfg, states, plans, root_keys, periods,
+                                  kind)
+
+
 def detection_study(n: int = 1000, crash_fraction: float = 0.01,
                     periods: int = 100, seed: int = 0,
                     engine: str = "auto",

@@ -288,6 +288,90 @@ def link_lanes(prog: FaultProgram, step):
             jnp.minimum(reply, cap))
 
 
+# ---------------------------------------------------------------------------
+# ProgramBatch: a library of fault programs stacked along a leading P axis
+# (sim/runner.py vmaps the study runners over it; sim/scenario.py batches a
+# whole arm library into one device run)
+# ---------------------------------------------------------------------------
+
+
+class ProgramBatch(NamedTuple):
+    """`size` FaultPrograms stacked leaf-wise along a new leading P axis.
+
+    Host-side container: pass `batch.program` (whose every leaf carries
+    the extra [P] dim) into vmapped runners so each lane sees an
+    ordinary FaultProgram pytree — isinstance checks, `split_program`,
+    and `link_lanes` all work unchanged per lane.  All members share one
+    N and one capacity S (`stack_programs` pads to the max), so the
+    whole batch traces ONE step; inert padding slots contribute exactly
+    zero to every lane threshold, which is what makes a padded lane
+    bitwise-identical to its serial run at its own capacity.
+    """
+
+    program: FaultProgram  # leaves stacked: base [P,N]/[P], segs [P,S]
+    size: int              # P (static)
+
+
+def pad_program(prog: FaultProgram, capacity: int) -> FaultProgram:
+    """Grow a program's segment axis to `capacity` with inert slots.
+
+    Padding slots are KIND_NONE at level 0 targeting domain -1 — they
+    add 0 to every lane, so the padded program is behaviorally (and,
+    because lanes are a pure sum over S, bitwise) identical.
+    """
+    s = int(prog.seg_kind.shape[0])
+    pad = int(capacity) - s
+    if pad < 0:
+        raise ValueError(
+            f"pad_program: capacity {capacity} < current {s} segments")
+    if pad == 0:
+        return prog
+    zi = jnp.zeros((pad,), jnp.int32)
+    return prog._replace(
+        seg_start=jnp.concatenate([prog.seg_start, zi]),
+        seg_end=jnp.concatenate([prog.seg_end, zi]),
+        seg_period=jnp.concatenate([prog.seg_period, zi]),
+        seg_on=jnp.concatenate([prog.seg_on, zi]),
+        seg_domain=jnp.concatenate(
+            [prog.seg_domain, jnp.full((pad,), -1, jnp.int32)]),
+        seg_kind=jnp.concatenate([prog.seg_kind, zi]),
+        seg_level=jnp.concatenate(
+            [prog.seg_level, jnp.zeros((pad,), jnp.uint32)]))
+
+
+def stack_programs(progs: list[FaultProgram] | tuple[FaultProgram, ...],
+                   capacity: int | None = None) -> ProgramBatch:
+    """Stack a program library into one ProgramBatch.
+
+    All members must share one node count N; segment capacities are
+    padded up to `capacity` (default: the library max) so the batch has
+    a single S trace axis."""
+    progs = list(progs)
+    if not progs:
+        raise ValueError("stack_programs: empty program list")
+    ns = {int(p.domain_id.shape[0]) for p in progs}
+    if len(ns) != 1:
+        raise ValueError(
+            f"stack_programs: mixed node counts {sorted(ns)}; a batch "
+            f"shares one N")
+    cap = max(int(p.seg_kind.shape[0]) for p in progs)
+    if capacity is not None:
+        if int(capacity) < cap:
+            raise ValueError(
+                f"stack_programs: capacity {capacity} < library max {cap}")
+        cap = int(capacity)
+    padded = [pad_program(p, cap) for p in progs]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *padded)
+    return ProgramBatch(program=stacked, size=len(progs))
+
+
+def lane_program(batch: ProgramBatch, p: int) -> FaultProgram:
+    """Lane `p`'s FaultProgram (indexes every stacked leaf)."""
+    if not 0 <= p < batch.size:
+        raise IndexError(f"lane {p} out of range for batch of {batch.size}")
+    return jax.tree.map(lambda x: x[p], batch.program)
+
+
 def crashed_mask(plan: FaultPlan, step) -> jax.Array:
     """bool[N]: which nodes have crash-stopped by period `step`."""
     return jnp.asarray(step, jnp.int32) >= plan.crash_step

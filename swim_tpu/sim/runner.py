@@ -343,6 +343,63 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
     return RingStudyResult(state, track, PeriodSeries(*series), frames)
 
 
+# ---------------------------------------------------------------------------
+# Batched studies: one device step advances P scenarios (sim/faults.py
+# ProgramBatch).  jax.vmap over the raw study bodies gives every output a
+# leading [P] axis — states [P, ...], track [P, N], series [P, T], telemetry
+# frames [P, T, ...] — and each lane is bitwise-identical to its serial run
+# (the parity contract tests/test_scenario_batch.py pins per engine,
+# including the sharded ring, where vmap composes over the shard_map'd
+# step closure).
+# ---------------------------------------------------------------------------
+
+# The un-jitted study bodies (jit-of-jit would discard the inner donation
+# and the vmap must wrap the raw traceable).
+_SERIAL_BODIES = {
+    "dense": run_study.__wrapped__,
+    "rumor": run_study_rumor.__wrapped__,
+    "ring": run_study_ring.__wrapped__,
+}
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6),
+                   donate_argnums=(1,))
+def run_study_batch(cfg: SwimConfig, states, plans, root_keys,
+                    periods: int, kind: str, step_fn=None):
+    """Vmapped study: `states`/`plans`/`root_keys` are pytrees whose
+    leaves carry a leading P axis (build with `batch_states` /
+    faults.stack_programs); ONE compiled step advances all P lanes.
+
+    `kind` selects the engine body ("dense" | "rumor" | "ring");
+    `step_fn` (rumor/ring only) is the same static stepper override the
+    serial runners take — the sharded ring passes its mapped_step
+    closure and vmap composes over the shard_map.  Returns the engine's
+    StudyResult with every leaf batched; de-interleave lanes with
+    `lane_result`."""
+    body = _SERIAL_BODIES[kind]
+    if kind == "dense":
+        fn = lambda s, p, k: body(cfg, s, p, k, periods)  # noqa: E731
+    else:
+        fn = lambda s, p, k: body(cfg, s, p, k, periods,  # noqa: E731
+                                  step_fn)
+    return jax.vmap(fn)(states, plans, root_keys)
+
+
+def batch_states(states) -> Any:
+    """Stack per-lane engine states leaf-wise along a new leading P axis."""
+    states = list(states)
+    if not states:
+        raise ValueError("batch_states: empty state list")
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+
+def lane_result(result, p: int):
+    """Lane `p` of a batched StudyResult (indexes every stacked leaf;
+    a None telemetry slot stays None — it is tree structure, not a
+    leaf)."""
+    return jax.tree.map(lambda x: x[p], result)
+
+
 def study_milestones(result: StudyResult, plan: FaultPlan,
                      periods: int) -> tuple[np.ndarray, dict]:
     """(crash steps, milestone arrays) restricted to CRASHED subjects —

@@ -328,6 +328,17 @@ def _arm_scenario(sc: Scenario, spec: dict) -> Scenario:
     return dataclasses.replace(sc, **repl) if repl else sc
 
 
+def _arm_prepare(sc: Scenario, spec: dict) -> tuple:
+    """Arm spec → (arm-overridden scenario, SwimConfig, compiled
+    program) — the static half of an arm run, shared by the serial and
+    batched paths."""
+    sc = _arm_scenario(sc, spec)
+    cfg_kw = {**dict(sc.config), **dict(spec.get("config", {}))}
+    cfg_kw.setdefault("telemetry", True)
+    cfg = SwimConfig(n_nodes=sc.n, **cfg_kw)
+    return sc, cfg, compile_program(sc)
+
+
 def _run_engine_arm(sc: Scenario, arm: str, spec: dict,
                     out_dir: str) -> dict:
     """One engine arm: compile, run the study scan with telemetry,
@@ -336,20 +347,63 @@ def _run_engine_arm(sc: Scenario, arm: str, spec: dict,
     the same path `swim-tpu observe --check` takes."""
     import jax
 
+    from swim_tpu.sim import experiments
+
+    sc, cfg, prog = _arm_prepare(sc, spec)
+    engine = experiments.pick_engine(sc.n, sc.engine)
+    res = experiments._run_study(cfg, prog, jax.random.key(sc.seed),
+                                 sc.periods, engine)
+    return _arm_digest(sc, arm, engine, cfg, prog, res, out_dir)
+
+
+def _run_engine_arms_batched(sc: Scenario, out_dir: str) -> dict:
+    """All engine arms of one scenario as vmapped fleets: arms sharing
+    a SwimConfig (config overrides are the only static divergence —
+    loss/events/partition/crashes/seed are data) group into ONE
+    batched device run (`experiments._run_study_batch`), each arm's
+    program padded to the group capacity; lanes de-interleave through
+    the SAME `_arm_digest` the serial path uses, so per-arm dicts,
+    dumps, and verdicts are bitwise-identical to serial runs.
+
+    Pricing note: the ICI bill is traced from the arm's OWN compiled
+    program (pre-padding), never the padded batch copy — a padded lane
+    must not sprout keys its serial twin lacks."""
+    import jax
+
+    from swim_tpu.sim import experiments, runner
+
+    engine = experiments.pick_engine(sc.n, sc.engine)
+    prepared = [(arm, *_arm_prepare(sc, spec))
+                for arm, spec, _gate in _arm_defs(sc)]
+    groups: dict[Any, list[int]] = {}
+    for i, (_arm, _sc_a, cfg, _prog) in enumerate(prepared):
+        groups.setdefault(cfg, []).append(i)
+    arms_out: dict[str, dict] = {}
+    for cfg, idxs in groups.items():
+        progs = [prepared[i][3] for i in idxs]
+        keys = [jax.random.key(prepared[i][1].seed) for i in idxs]
+        res_b = experiments._run_study_batch(cfg, progs, keys,
+                                             sc.periods, engine)
+        for lane, i in enumerate(idxs):
+            arm, sc_a, cfg_i, prog = prepared[i]
+            res = runner.lane_result(res_b, lane)
+            arms_out[arm] = _arm_digest(sc_a, arm, engine, cfg_i, prog,
+                                        res, out_dir)
+    return {arm: arms_out[arm] for arm, _, _ in _arm_defs(sc)}
+
+
+def _arm_digest(sc: Scenario, arm: str, engine: str, cfg: SwimConfig,
+                prog: faults.FaultProgram, res, out_dir: str) -> dict:
+    """Post-run half of an arm: metric digests, ICI pricing, health
+    monitor + flight-record dump, offline-analyzer replay.  `res` is
+    either a serial StudyResult or one de-interleaved lane of a batch —
+    identical inputs produce identical (byte-stable) outputs."""
     from swim_tpu.obs import analyze
     from swim_tpu.obs.health import HealthMonitor
     from swim_tpu.obs.recorder import FlightRecorder
-    from swim_tpu.sim import experiments, runner
+    from swim_tpu.sim import runner
     from swim_tpu.utils import metrics
 
-    sc = _arm_scenario(sc, spec)
-    engine = experiments.pick_engine(sc.n, sc.engine)
-    cfg_kw = {**dict(sc.config), **dict(spec.get("config", {}))}
-    cfg_kw.setdefault("telemetry", True)
-    cfg = SwimConfig(n_nodes=sc.n, **cfg_kw)
-    prog = compile_program(sc)
-    res = experiments._run_study(cfg, prog, jax.random.key(sc.seed),
-                                 sc.periods, engine)
     series = res.series
     out: dict[str, Any] = {"engine": engine}
     out.update(runner.detection_summary(res, prog, sc.periods))
@@ -644,12 +698,18 @@ def write_verdict(verdict: dict, path: str) -> str:
     return path
 
 
-def run(sc: Scenario, out_dir: str = "bench_results") -> tuple[dict, str]:
+def run(sc: Scenario, out_dir: str = "bench_results",
+        batch: bool = False) -> tuple[dict, str]:
     """Execute a scenario end to end and write its verdict artifact.
 
     Returns (verdict dict, artifact path).  verdict["verdict"] is
     "pass" iff every check (the mandatory observatory gate plus the
-    spec's `expect` list) holds."""
+    spec's `expect` list) holds.
+
+    `batch=True` runs the engine arms as vmapped fleets (one device
+    run per shared SwimConfig) instead of serially — the verdict is
+    bitwise-identical either way (study/real modes have no arm fleet
+    and ignore the flag)."""
     validate(sc)
     os.makedirs(out_dir, exist_ok=True)
     arms: dict[str, dict] = {}
@@ -657,6 +717,8 @@ def run(sc: Scenario, out_dir: str = "bench_results") -> tuple[dict, str]:
         arms["study"] = _run_study_mode(sc, out_dir)
     elif sc.engine == "real":
         arms["real"] = _run_real_arm(sc, out_dir)
+    elif batch:
+        arms = _run_engine_arms_batched(sc, out_dir)
     else:
         for arm, spec, _gate in _arm_defs(sc):
             arms[arm] = _run_engine_arm(sc, arm, spec, out_dir)
@@ -737,6 +799,39 @@ def _lib() -> dict[str, Scenario]:
                         "the ungated storm arm pins that regime and "
                         "proves the flap_false_dead health rule "
                         "fires."),
+        "flap_boundary": Scenario(
+            name="flap_boundary", n=256, periods=48, engine="ring",
+            config=ring_cfg, domains="blocks:8",
+            events=(
+                {"kind": "link_loss", "domain": 3, "start": 8,
+                 "end": 40, "level": 0.261209, "period": 6, "on": 3},
+            ),
+            arms={
+                "edge_clean": {},
+                "edge_storm": {"gate": False, "events": (
+                    {"kind": "link_loss", "domain": 3, "start": 8,
+                     "end": 40, "level": 0.261493, "period": 6,
+                     "on": 3},
+                )},
+            },
+            expect=(
+                {"check": "metric_zero", "arm": "edge_clean",
+                 "metric": "false_dead_views_final"},
+                {"check": "lane_charged", "arm": "edge_clean"},
+                {"check": "metric_nonzero", "arm": "edge_storm",
+                 "metric": "false_dead_views_final"},
+            ),
+            description="Machine-found sticky-false-dead frontier of "
+                        "the flap duty cycle (coverage-guided search, "
+                        "seed 0: sim/search.py refine_boundary over "
+                        "the 3-on/3-off link-loss template).  At burst "
+                        "loss 0.261209 Lifeguard still converges to "
+                        "zero false-dead views; 0.000284 higher, at "
+                        "0.261493, refutations stop landing inside "
+                        "the flap window and DEAD views stick past "
+                        "recovery.  Pins the measured cliff between "
+                        "the hand-picked flap anchors (0.2 clean / "
+                        "0.5 storm)."),
         "gray_10pct": Scenario(
             name="gray_10pct", n=256, periods=48, engine="ring",
             config=ring_cfg, domains="blocks:10",

@@ -1,0 +1,334 @@
+"""Coverage-guided adversarial search over fault-program parameters.
+
+The scenario library's calibration points were found by hand (the 30%
+rack-loss boundary in bench_results/scenario_rack_outage.json, the
+0.43 gray level, the 50% flap storm).  This module automates that
+boundary mapping — Lifeguard's evaluation methodology (sweep the fault
+severity until the detector breaks, report the frontier) driven by the
+batched scenario pipeline: every generation compiles P mutated
+candidates at one shared segment capacity and advances them all in ONE
+vmapped device run (`sim/experiments._run_study_batch`), so the search
+pays one compile and then P scenarios per step forever after.
+
+Two phases:
+
+  * `explore` — novelty-guided mutation over (kind, level, window,
+    duty cycle, domain, crash co-injection).  Each lane reduces to a
+    coarse behavior signature (log-bucketed false-dead peak/final,
+    suspect volume, undetected-crash count, incarnation ceiling); the
+    archive keeps the first candidate per signature and parents are
+    drawn from it, so the population is pushed toward behaviors not
+    yet seen rather than re-sampling the basin it started in.
+    Violation detectors run per lane: a sticky false death under the
+    full Lifeguard config (the detector killed a healthy node), a
+    false-dead storm (cascade), and an undetected crash (a node that
+    crash-stopped mid-run and never reached a DEAD view).
+  * `refine_boundary` — batched bisection along one parameter: each
+    generation evaluates a P-point grid spanning the current bracket
+    and tightens it to [max clean, min violating], so the frontier
+    narrows by ~P× per device step instead of 2×.
+
+Everything is deterministic given `seed` (np.random.default_rng for
+mutation, fixed engine keys), and the report is a byte-stable JSON
+artifact (sorted keys, no timestamps) in the verdict family — the
+machine-found boundary lands in the scenario library as an ordinary
+spec with a committed passing verdict (`flap_boundary`).
+
+CLI: ``swim-tpu scenario search [--generations G] [--pop P] [--out F]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.sim import faults, scenario
+
+NEVER = 2**31 - 1
+
+# The searched geometry: the library's flap/gray anchor (n=256, 8 racks,
+# full Lifeguard stack on the packed rotor wire).  Small enough that a
+# 16-lane generation steps in ~a second on the CPU host, and identical
+# to the committed library scenarios so a found boundary transplants
+# into the library verbatim.
+SEARCH_N = 256
+SEARCH_PERIODS = 48
+SEARCH_DOMAINS = "blocks:8"
+SEARCH_CONFIG: Mapping[str, Any] = {
+    "ring_probe": "rotor", "ring_scalar_wire": "packed",
+    "ring_sel_scope": "period", "lifeguard": True, "buddy": True,
+}
+# one lane-event slot + one optional crash co-injection (crashes fold
+# into the base plan, so capacity 1 covers every candidate) — fixed so
+# the whole search shares a single compiled step
+SEARCH_CAPACITY = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point in the fault-parameter space (JSON-able)."""
+
+    kind: str = "link_loss"     # link_loss | gray | send_loss | recv_loss
+    level: float = 0.2          # loss probability of the lane event
+    start: int = 8              # window first period (inclusive)
+    end: int = 40               # window last period (exclusive)
+    period: int = 0             # flap cycle (0 = always on in window)
+    on: int = 0                 # on-duty periods per cycle
+    domain: int = 3             # target rack
+    crash_domain: int = -1      # -1 = none; else that rack crash-stops
+    crash_start: int = 12
+
+    def events(self) -> tuple:
+        ev: list[dict] = [{
+            "kind": self.kind, "start": self.start, "end": self.end,
+            "level": round(float(self.level), 6),
+            "domain": self.domain,
+            "period": self.period, "on": self.on,
+        }]
+        if self.crash_domain >= 0:
+            ev.append({"kind": "crash", "domain": self.crash_domain,
+                       "start": self.crash_start})
+        return tuple(ev)
+
+    def to_scenario(self, name: str, seed: int = 0,
+                    **overrides) -> scenario.Scenario:
+        return scenario.Scenario(
+            name=name, n=SEARCH_N, periods=SEARCH_PERIODS, engine="ring",
+            seed=seed, config=dict(SEARCH_CONFIG),
+            domains=SEARCH_DOMAINS, capacity=SEARCH_CAPACITY,
+            events=self.events(), **overrides)
+
+    def spec_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _compile(cand: Candidate, seed: int) -> faults.FaultProgram:
+    return scenario.compile_program(cand.to_scenario("search", seed=seed))
+
+
+def run_generation(cands: list[Candidate], seed: int = 0):
+    """One vmapped device step over a candidate population.
+
+    Returns the batched StudyResult; all candidates share the search
+    geometry/config, so the whole population is one batch group."""
+    import jax
+
+    from swim_tpu.sim import experiments
+
+    cfg = SwimConfig(n_nodes=SEARCH_N, telemetry=True, **SEARCH_CONFIG)
+    progs = [_compile(c, seed) for c in cands]
+    keys = [jax.random.key(seed) for _ in cands]
+    return experiments._run_study_batch(
+        cfg, progs, keys, SEARCH_PERIODS, "ring",
+        capacity=SEARCH_CAPACITY)
+
+
+def lane_signature(res, cand: Candidate) -> dict:
+    """Coarse behavior signature + raw observables for one lane."""
+    fd = np.asarray(res.series.false_dead_views)
+    susp = np.asarray(res.series.suspect_views)
+    inc = np.asarray(res.series.max_incarnation)
+    first_dead = np.asarray(res.track.first_dead_view)
+    # undetected crashes: crashed early enough that detection is due
+    # (>= 8 periods of margin), yet no DEAD view ever formed
+    undetected = 0
+    crashed_due = 0
+    if cand.crash_domain >= 0:
+        dom = scenario.domain_labels(SEARCH_N, SEARCH_DOMAINS)
+        members = np.nonzero(dom == cand.crash_domain)[0]
+        if cand.crash_start <= SEARCH_PERIODS - 8:
+            crashed_due = int(members.size)
+            undetected = int((first_dead[members] == NEVER).sum())
+
+    def bucket(v: int) -> int:
+        return 0 if v <= 0 else int(math.log10(v)) + 1
+
+    obs = {
+        "false_dead_peak": int(fd.max()),
+        "false_dead_final": int(fd[-1]),
+        "suspect_peak": int(susp.max()),
+        "max_incarnation": int(inc.max()),
+        "crashed_due": crashed_due,
+        "undetected_crashes": undetected,
+    }
+    sig = (bucket(obs["false_dead_peak"]), bucket(obs["false_dead_final"]),
+           bucket(obs["suspect_peak"]), bucket(obs["max_incarnation"]),
+           1 if undetected else 0)
+    return {"signature": sig, **obs}
+
+
+def violations_of(sig: dict, cand: Candidate) -> list[str]:
+    """Which detector-breaking behaviors this lane exhibits.
+
+    All candidates run the FULL Lifeguard stack, so a false death here
+    is the detector failing, not an ablation arm failing on purpose."""
+    out = []
+    if sig["false_dead_final"] > 0:
+        out.append("sticky_false_dead")
+    if sig["false_dead_peak"] >= 100:
+        out.append("false_dead_storm")
+    if sig["undetected_crashes"] > 0:
+        out.append("undetected_crash")
+    return out
+
+
+def _mutate(cand: Candidate, rng: np.random.Generator) -> Candidate:
+    """Perturb one or two parameters (bounded to the valid spec box)."""
+    d = dataclasses.asdict(cand)
+    for _ in range(int(rng.integers(1, 3))):
+        which = rng.choice(["level", "window", "duty", "domain", "kind",
+                            "crash"])
+        if which == "level":
+            d["level"] = float(np.clip(
+                d["level"] + rng.normal(0, 0.12), 0.02, 0.98))
+        elif which == "window":
+            d["start"] = int(rng.integers(2, 20))
+            d["end"] = int(d["start"]
+                           + rng.integers(6, SEARCH_PERIODS - d["start"]))
+        elif which == "duty":
+            if rng.random() < 0.3:
+                d["period"], d["on"] = 0, 0
+            else:
+                d["period"] = int(rng.integers(2, 9))
+                d["on"] = int(rng.integers(1, d["period"] + 1))
+        elif which == "domain":
+            d["domain"] = int(rng.integers(0, 8))
+        elif which == "kind":
+            d["kind"] = str(rng.choice(
+                ["link_loss", "gray", "send_loss", "recv_loss"]))
+        elif which == "crash":
+            if rng.random() < 0.5:
+                d["crash_domain"] = -1
+            else:
+                d["crash_domain"] = int(rng.integers(0, 8))
+                d["crash_start"] = int(rng.integers(4, 30))
+        if d["crash_domain"] == d["domain"]:
+            d["crash_domain"] = -1   # crashing the faulted rack masks it
+    d["end"] = int(min(d["end"], SEARCH_PERIODS))
+    return Candidate(**d)
+
+
+def explore(generations: int = 4, pop: int = 16, seed: int = 0) -> dict:
+    """Novelty-guided exploration: returns the archive + violations."""
+    rng = np.random.default_rng(seed)
+    from swim_tpu.sim import runner
+
+    seedling = Candidate()
+    archive: dict[tuple, dict] = {}
+    violations: list[dict] = []
+    parents = [seedling]
+    evaluated = 0
+    for gen in range(generations):
+        cands = []
+        for i in range(pop):
+            if i < 2 or not parents:
+                base = seedling
+            else:
+                base = parents[int(rng.integers(0, len(parents)))]
+            cands.append(_mutate(base, rng))
+        res_b = run_generation(cands, seed=seed)
+        fresh = []
+        for lane, cand in enumerate(cands):
+            sig = lane_signature(runner.lane_result(res_b, lane), cand)
+            evaluated += 1
+            key = sig["signature"]
+            if key not in archive:
+                archive[key] = {"candidate": cand.spec_dict(),
+                                "generation": gen, **sig,
+                                "signature": list(key)}
+                fresh.append(cand)
+            for v in violations_of(sig, cand):
+                violations.append({"violation": v, "generation": gen,
+                                   "candidate": cand.spec_dict(), **sig,
+                                   "signature": list(key)})
+        # novelty guidance: parents are the candidates that just opened
+        # new signature cells (fall back to the whole archive when a
+        # generation goes dry)
+        parents = fresh or [Candidate(**a["candidate"])
+                            for a in archive.values()]
+    return {
+        "generations": generations, "pop": pop, "seed": seed,
+        "evaluated": evaluated,
+        "archive": sorted(archive.values(),
+                          key=lambda a: a["signature"]),
+        "violations": violations,
+    }
+
+
+def refine_boundary(template: Candidate,
+                    predicate: Callable[[dict], bool] | None = None,
+                    lo: float = 0.02, hi: float = 0.98,
+                    pop: int = 16, tol: float = 0.005,
+                    max_generations: int = 6, seed: int = 0) -> dict:
+    """Batched bisection of the `level` frontier for one candidate
+    shape: per generation, evaluate a `pop`-point grid spanning the
+    bracket and tighten it to [max clean level, min violating level].
+    ~pop× narrowing per device step vs 2× for scalar bisection."""
+    from swim_tpu.sim import runner
+
+    if predicate is None:
+        predicate = lambda sig: sig["false_dead_final"] > 0  # noqa: E731
+    history = []
+    for gen in range(max_generations):
+        levels = list(np.linspace(lo, hi, pop))
+        cands = [dataclasses.replace(template, level=float(lv))
+                 for lv in levels]
+        res_b = run_generation(cands, seed=seed)
+        sigs = [lane_signature(runner.lane_result(res_b, lane), c)
+                for lane, c in enumerate(cands)]
+        viol = [bool(predicate(s)) for s in sigs]
+        new_lo, new_hi = lo, hi
+        for lv, v in zip(levels, viol):
+            if not v and lv > new_lo:
+                # highest clean level BELOW the first violation only —
+                # a non-monotone pocket must not fold the bracket past
+                # a violating level
+                if not any(vv and lx < lv for lx, vv in zip(levels, viol)):
+                    new_lo = lv
+        for lv, v in zip(levels, viol):
+            if v:
+                new_hi = min(new_hi, lv)
+                break
+        history.append({"generation": gen, "lo": lo, "hi": hi,
+                        "grid": [round(float(lv), 6) for lv in levels],
+                        "violating": viol})
+        if not any(viol):
+            return {"found": False, "lo": lo, "hi": hi,
+                    "history": history,
+                    "note": "no violation in bracket"}
+        lo, hi = new_lo, new_hi
+        if hi - lo <= tol:
+            break
+    return {
+        "found": True,
+        "clean_level": round(float(lo), 6),
+        "violation_level": round(float(hi), 6),
+        "width": round(float(hi - lo), 6),
+        "template": template.spec_dict(),
+        "history": history,
+    }
+
+
+def search(generations: int = 4, pop: int = 16, seed: int = 0,
+           out: str | None = None) -> dict:
+    """The full driver: explore, then refine the flap false-dead
+    frontier (the library's `flap_boundary` scenario is this report's
+    committed form).  Deterministic given `seed`; the report is a
+    byte-stable JSON artifact when `out` is given."""
+    report: dict[str, Any] = {"kind": "scenario_search", "version": 1,
+                              "n": SEARCH_N, "periods": SEARCH_PERIODS,
+                              "config": dict(SEARCH_CONFIG),
+                              "domains": SEARCH_DOMAINS}
+    report["explore"] = explore(generations=generations, pop=pop,
+                                seed=seed)
+    flap = Candidate(kind="link_loss", start=8, end=40, period=6, on=3,
+                     domain=3)
+    report["boundary"] = refine_boundary(flap, pop=pop, seed=seed)
+    if out:
+        scenario.write_verdict(report, out)
+        report["artifact"] = out
+    return report

@@ -1,0 +1,329 @@
+"""Scenario fleet: vmapped program batching + coverage-guided search.
+
+Contracts from the program-batch axis (sim/faults.py ProgramBatch,
+sim/runner.py run_study_batch, sim/experiments._run_study_batch,
+sim/scenario.py `run(batch=True)`) and the search driver
+(sim/search.py):
+
+  1. BATCH PLUMBING is exact: padding appends inert slots only,
+     stacking validates shared-N and capacity, lanes round-trip.
+  2. PARITY is bitwise: a P=1 batch equals the serial run leaf-for-
+     leaf; every lane of a P=K batch equals ITS OWN serial run —
+     including lanes padded up to the batch capacity — on dense,
+     rumor and ring, and through the sharded ring path on the
+     8-device virtual mesh.
+  3. The BATCHED SCENARIO RUNNER is invisible in the artifact:
+     `scenario.run(sc, batch=True)` writes byte-identical verdicts
+     (modulo the out_dir prefix) with per-lane observatory gating
+     unchanged.
+  4. The SEARCH DRIVER is deterministic and its boundary bisection
+     brackets a violation frontier to tolerance (engine stubbed — the
+     bracketing logic, the non-monotone-pocket guard and the no-
+     violation escape are host-side control flow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.sim import experiments, faults, runner, scenario, search
+
+RING_KW = dict(lifeguard=True, buddy=True, ring_probe="rotor",
+               ring_sel_scope="period", ring_scalar_wire="packed",
+               telemetry=True)
+
+
+def _sc(**kw):
+    kw.setdefault("name", "t")
+    return scenario.Scenario(**kw)
+
+
+def _prog(n, periods, events=(), capacity=None):
+    return scenario.compile_program(
+        _sc(n=n, periods=periods, domains="blocks:4", capacity=capacity,
+            events=list(events)))
+
+
+def _leaves_equal(a, b, msg=""):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb), f"{msg}: tree structure differs"
+    for i, (x, y) in enumerate(zip(la, lb)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{msg}: leaf {i}")
+
+
+EV_LOSS = {"kind": "link_loss", "start": 1, "end": 5, "level": 0.4,
+           "domain": 2}
+EV_GRAY = {"kind": "gray", "start": 2, "end": 6, "level": 0.3,
+           "domain": 1}
+
+
+# ---------------------------------------------------------------------------
+# 1. Batch plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestProgramBatch:
+    def test_pad_appends_inert_slots_only(self):
+        p = _prog(8, 10, [EV_LOSS])
+        padded = faults.pad_program(p, 3)
+        assert int(padded.seg_kind.shape[0]) == 3
+        # original slot untouched
+        assert int(padded.seg_kind[0]) == faults.KIND_LINK_LOSS
+        assert int(padded.seg_level[0]) == faults.level_to_threshold(0.4)
+        # pad slots are KIND_NONE / level 0 / domain -1
+        np.testing.assert_array_equal(np.asarray(padded.seg_kind[1:]),
+                                      [faults.KIND_NONE] * 2)
+        np.testing.assert_array_equal(np.asarray(padded.seg_level[1:]),
+                                      [0, 0])
+        np.testing.assert_array_equal(np.asarray(padded.seg_domain[1:]),
+                                      [-1, -1])
+        # base plan is untouched by padding
+        _leaves_equal(padded.base, p.base, "padded base")
+
+    def test_pad_noop_and_shrink_rejected(self):
+        p = _prog(8, 10, [EV_LOSS])
+        assert faults.pad_program(p, 1) is p
+        with pytest.raises(ValueError):
+            faults.pad_program(p, 0)
+
+    def test_stack_pads_to_library_max(self):
+        p1 = _prog(8, 10, [EV_LOSS])
+        p2 = _prog(8, 10, [EV_LOSS, EV_GRAY])
+        batch = faults.stack_programs([p1, p2])
+        assert batch.size == 2
+        assert tuple(batch.program.seg_kind.shape) == (2, 2)
+        assert tuple(batch.program.domain_id.shape) == (2, 8)
+        # lane round-trip: lane 0 is p1 padded to S=2, lane 1 is p2
+        _leaves_equal(faults.lane_program(batch, 0),
+                      faults.pad_program(p1, 2), "lane 0")
+        _leaves_equal(faults.lane_program(batch, 1), p2, "lane 1")
+
+    def test_stack_explicit_capacity_and_errors(self):
+        p1 = _prog(8, 10, [EV_LOSS])
+        assert int(faults.stack_programs(
+            [p1], capacity=4).program.seg_kind.shape[1]) == 4
+        with pytest.raises(ValueError):
+            faults.stack_programs([])
+        with pytest.raises(ValueError):
+            faults.stack_programs([p1, _prog(12, 10, [EV_LOSS])])
+        with pytest.raises(ValueError):
+            faults.stack_programs([p1, _prog(8, 10, [EV_LOSS, EV_GRAY])],
+                                  capacity=1)
+
+    def test_lane_out_of_range(self):
+        batch = faults.stack_programs([_prog(8, 10, [EV_LOSS])])
+        with pytest.raises(IndexError):
+            faults.lane_program(batch, 1)
+
+
+# ---------------------------------------------------------------------------
+# 2. Bitwise parity: batched vs serial
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedParity:
+    N, T = 32, 6
+
+    def _events(self, i):
+        # distinct per-lane programs: different levels AND segment
+        # counts, so the batch exercises capacity padding
+        if i == 0:
+            return []
+        if i == 1:
+            return [EV_LOSS]
+        return [dict(EV_LOSS, level=0.15), EV_GRAY]
+
+    def _parity(self, engine, cfg):
+        progs = [_prog(self.N, self.T, self._events(i)) for i in range(3)]
+        keys = [jax.random.key(100 + i) for i in range(3)]
+        serial = [experiments._run_study(cfg, progs[i], keys[i], self.T,
+                                         engine) for i in range(3)]
+        batched = experiments._run_study_batch(cfg, progs, keys, self.T,
+                                               engine)
+        for p in range(3):
+            _leaves_equal(runner.lane_result(batched, p), serial[p],
+                          f"{engine} lane {p}")
+
+    def test_ring_lanes_bitwise(self):
+        self._parity("ring", SwimConfig(n_nodes=self.N, **RING_KW))
+
+    def test_dense_lanes_bitwise(self):
+        self._parity("dense", SwimConfig(n_nodes=self.N, telemetry=True))
+
+    def test_rumor_lanes_bitwise(self):
+        self._parity("rumor", SwimConfig(n_nodes=self.N, telemetry=True))
+
+    def test_p1_batch_equals_serial(self):
+        cfg = SwimConfig(n_nodes=self.N, **RING_KW)
+        prog = _prog(self.N, self.T, [EV_LOSS])
+        key = jax.random.key(7)
+        serial = experiments._run_study(cfg, prog, key, self.T, "ring")
+        batched = experiments._run_study_batch(cfg, [prog], [key], self.T,
+                                               "ring")
+        _leaves_equal(runner.lane_result(batched, 0), serial, "P=1")
+
+    def test_explicit_capacity_padding_is_invisible(self):
+        # a lane padded well past its own S must still be bitwise its
+        # serial (unpadded) run — the inert-slot invariant end to end
+        cfg = SwimConfig(n_nodes=self.N, **RING_KW)
+        prog = _prog(self.N, self.T, [EV_LOSS])
+        key = jax.random.key(9)
+        serial = experiments._run_study(cfg, prog, key, self.T, "ring")
+        batched = experiments._run_study_batch(cfg, [prog], [key], self.T,
+                                               "ring", capacity=4)
+        _leaves_equal(runner.lane_result(batched, 0), serial,
+                      "padded lane")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual mesh")
+class TestShardedBatchedParity:
+    """The vmapped batch composes OVER the shard_map'd ring step: each
+    lane of the batched ringshard run is bitwise its own sharded serial
+    run (which TestShardedProgramParity already ties to the global
+    engine — so the chain batched == sharded == global closes)."""
+
+    def test_lanes_bitwise(self):
+        n, periods = 32, 5
+        cfg = SwimConfig(n_nodes=n, suspicion_mult=1.0, k_indirect=1,
+                         max_piggyback=2, ring_window_periods=2,
+                         ring_view_c=2, telemetry=True, **{
+                             k: v for k, v in RING_KW.items()
+                             if k != "telemetry"})
+        progs = [_prog(n, periods, ev) for ev in
+                 ([], [EV_LOSS], [dict(EV_LOSS, level=0.2), EV_GRAY])]
+        keys = [jax.random.key(40 + i) for i in range(3)]
+        serial = [experiments._run_study(cfg, progs[i], keys[i], periods,
+                                         "ringshard") for i in range(3)]
+        batched = experiments._run_study_batch(cfg, progs, keys, periods,
+                                               "ringshard")
+        for p in range(3):
+            _leaves_equal(runner.lane_result(batched, p), serial[p],
+                          f"ringshard lane {p}")
+
+
+# ---------------------------------------------------------------------------
+# 3. Batched scenario runner: byte-identical verdicts
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedScenarioRun:
+    def _spec(self):
+        return _sc(name="minifleet", n=32, periods=6, engine="ring",
+                   config={k: v for k, v in RING_KW.items()
+                           if k != "telemetry"},
+                   domains="blocks:4",
+                   events=(dict(EV_LOSS, level=0.1),),
+                   arms={"a": {}, "b": {"gate": False, "events": (
+                       dict(EV_LOSS, level=0.6),)}},
+                   expect=())
+
+    def test_verdict_bytes_identical(self, tmp_path):
+        d_ser = tmp_path / "ser"
+        d_bat = tmp_path / "bat"
+        sc = self._spec()
+        _, p_ser = scenario.run(sc, out_dir=str(d_ser))
+        _, p_bat = scenario.run(sc, out_dir=str(d_bat), batch=True)
+        a = open(p_ser).read().replace(str(d_ser), "OUT")
+        b = open(p_bat).read().replace(str(d_bat), "OUT")
+        assert a == b
+        v = json.loads(b)
+        assert set(v["arms"]) == {"a", "b"}
+        # the two arms really diverged (distinct programs per lane)
+        assert v["arms"]["a"] != v["arms"]["b"]
+
+    def test_real_engine_rejects_batch(self):
+        with pytest.raises(ValueError):
+            experiments._run_study_batch(
+                SwimConfig(n_nodes=8), [_prog(8, 4)],
+                [jax.random.key(0)], 4, "shard")
+
+
+# ---------------------------------------------------------------------------
+# 4. Search driver (engine stubbed: host-side control flow)
+# ---------------------------------------------------------------------------
+
+
+class TestSearchDriver:
+    def test_candidate_events_and_scenario(self):
+        c = search.Candidate(kind="gray", level=0.3141592653, start=4,
+                             end=20, period=6, on=3, domain=5,
+                             crash_domain=2, crash_start=10)
+        ev = c.events()
+        assert ev[0]["kind"] == "gray" and ev[0]["level"] == 0.314159
+        assert ev[1] == {"kind": "crash", "domain": 2, "start": 10}
+        spec = c.to_scenario("x", seed=3)
+        assert spec.n == search.SEARCH_N and spec.seed == 3
+        scenario.validate(spec)
+
+    def test_mutation_stays_in_box_and_is_deterministic(self):
+        rng1 = np.random.default_rng(5)
+        rng2 = np.random.default_rng(5)
+        c = search.Candidate()
+        for _ in range(200):
+            m = search._mutate(c, rng1)
+            assert 0.02 <= m.level <= 0.98
+            assert 2 <= m.start < 20
+            assert m.start < m.end <= search.SEARCH_PERIODS
+            assert 0 <= m.domain < 8
+            assert m.kind in ("link_loss", "gray", "send_loss",
+                              "recv_loss")
+            assert m.crash_domain != m.domain
+            assert search._mutate(c, rng2) == m
+            c = m
+
+    def _stub(self, monkeypatch, frontier):
+        # violation iff level > frontier: refine must bracket it
+        monkeypatch.setattr(
+            search, "run_generation",
+            lambda cands, seed=0: np.arange(len(cands)))
+        monkeypatch.setattr(
+            search, "lane_signature",
+            lambda res, cand: {
+                "signature": (0,), "false_dead_peak": 0,
+                "false_dead_final": 1 if cand.level > frontier else 0,
+                "suspect_peak": 0, "max_incarnation": 0,
+                "crashed_due": 0, "undetected_crashes": 0})
+
+    def test_refine_brackets_frontier(self, monkeypatch):
+        self._stub(monkeypatch, frontier=0.42)
+        b = search.refine_boundary(search.Candidate(), pop=8,
+                                   tol=0.001, seed=0)
+        assert b["found"]
+        assert b["clean_level"] <= 0.42 <= b["violation_level"]
+        assert b["width"] <= 0.001 + 1e-9
+        assert b["history"], "bisection history must be recorded"
+
+    def test_refine_no_violation_escapes(self, monkeypatch):
+        self._stub(monkeypatch, frontier=2.0)   # never violating
+        b = search.refine_boundary(search.Candidate(), pop=4, seed=0)
+        assert not b["found"] and "note" in b
+
+    def test_violations_of(self):
+        c = search.Candidate()
+        sig = {"false_dead_final": 1, "false_dead_peak": 500,
+               "undetected_crashes": 2}
+        assert search.violations_of(sig, c) == [
+            "sticky_false_dead", "false_dead_storm", "undetected_crash"]
+        assert search.violations_of(
+            {"false_dead_final": 0, "false_dead_peak": 0,
+             "undetected_crashes": 0}, c) == []
+
+    def test_library_boundary_matches_search_template(self):
+        """The committed flap_boundary levels must stay inside the
+        search template's geometry (same window / duty / domain as the
+        flap anchor) — a drift here means the library scenario no
+        longer documents the machine-found frontier."""
+        sc = scenario.get("flap_boundary")
+        ev = sc.events[0]
+        assert (ev["start"], ev["end"], ev["period"], ev["on"],
+                ev["domain"]) == (8, 40, 6, 3, 3)
+        storm = sc.arms["edge_storm"]["events"][0]
+        assert 0 < storm["level"] - ev["level"] < 0.01

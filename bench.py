@@ -717,6 +717,113 @@ def bench_scenario_batch(n_nodes: int, periods: int,
     }
 
 
+def bench_memwall(n_nodes: int, periods: int) -> dict:
+    """Memory-wall accounting tier (obs/memwall.py): AOT
+    `memory_analysis` of the detection-study program, plus an EXECUTED
+    small-N proof that the streaming study is the same computation.
+
+    Rows (each one study_memory_analysis report):
+      * cpu @ n_nodes, stream + stacked — always-available backend
+        (XLA:CPU overstates by ~1x state; the DELTAS are still real).
+      * tpu rows at flagship shapes (deviceless XLA:TPU — the compiler
+        whose compile-time HBM check produced the committed 16M OOM):
+        10M/16M stream, 16M stacked (the pre-streaming "before"), and
+        the 64M sharded flagship (per-chip bytes over the topology
+        mesh).  Skipped when n_nodes is smoke-sized (< 65536) or libtpu
+        cannot initialize; each skip is recorded, never silent.
+
+    The executed block runs stream-vs-stacked at 512 nodes and FAILS
+    the tier unless milestones, series and final state are bitwise
+    identical and the donated engine state was actually consumed —
+    the parity contract that makes the compiled-shape rows meaningful."""
+    import jax
+    import numpy as np
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import ring
+    from swim_tpu.obs import memwall
+    from swim_tpu.sim import faults, runner
+
+    periods = periods or 12
+    n_cpu = n_nodes or 65_536
+
+    rows: list = []
+
+    def row(**kw):
+        try:
+            rows.append(memwall.study_memory_analysis(periods=periods,
+                                                      **kw))
+        except Exception as e:  # noqa: BLE001 — a row failing is a datum
+            rows.append({"n": kw.get("n"), "variant": kw.get("variant"),
+                         "engine": kw.get("engine", "ring"),
+                         "platform": kw.get("platform"),
+                         "error": f"{type(e).__name__}: {e}"[:300]})
+
+    row(n=n_cpu, platform="cpu", variant="stream")
+    row(n=n_cpu, platform="cpu", variant="stacked")
+    if n_cpu >= 65_536:  # flagship shapes: skip in smoke (minutes each)
+        row(n=10_000_000, platform="tpu", variant="stream")
+        row(n=16_000_000, platform="tpu", variant="stream")
+        row(n=16_000_000, platform="tpu", variant="stacked")
+        row(n=64_000_000, platform="tpu", variant="stream",
+            engine="ringshard")
+
+    # executed parity + donation wiring at tiny N (CPU, sub-second)
+    n_p, p_p, chunk = 512, max(8, min(periods, 20)), 7
+    cfg = SwimConfig(n_nodes=n_p, ring_probe="pull")
+    key = jax.random.key(0)
+    plan = faults.with_random_crashes(faults.none(n_p), jax.random.key(1),
+                                      0.02, 2, max(3, p_p // 2))
+    full = runner.run_study_ring(cfg, ring.init_state(cfg), plan, key, p_p)
+    stream = runner.run_study_ring_stream(cfg, ring.init_state(cfg), plan,
+                                          key, p_p, chunk=chunk)
+    cr_f, m_f = runner.study_milestones(full, plan, p_p)
+    cr_s, m_s = runner.study_milestones(stream, plan, p_p)
+    milestone_parity = bool(
+        np.array_equal(cr_f, cr_s)
+        and all(np.array_equal(m_f[k], m_s[k]) for k in m_f))
+    series_parity = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(jax.tree.leaves(full.series),
+                        jax.tree.leaves(stream.series)))
+    state_parity = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(b)))
+        for a, b in zip(jax.tree.leaves(full.state),
+                        jax.tree.leaves(stream.state)))
+    st = ring.init_state(cfg)
+    st_leaves = jax.tree.leaves(st)
+    runner._run_study_ring_chunk(cfg, st, runner.compact_track_init(
+        plan, p_p), plan, key, p_p)
+    donated = all(x.is_deleted() for x in st_leaves)
+    ok = milestone_parity and series_parity and state_parity and donated
+
+    # headline anchor: the largest TPU row that produced buffer totals,
+    # else the CPU stream row (trend gates peak bytes per (tier, nodes,
+    # platform) series, so a platform change never aliases a series)
+    anchor = None
+    for r in rows:
+        if r.get("total_bytes") is None:
+            continue
+        if anchor is None or (r["n"], r["platform"] == "tpu") > \
+                (anchor["n"], anchor["platform"] == "tpu"):
+            anchor = r
+    return {
+        "nodes": n_cpu, "periods": periods, "rows": rows,
+        "milestone_parity": milestone_parity,
+        "series_parity": series_parity,
+        "state_parity": state_parity,
+        "donation_consumed": donated,
+        "ok_parity": ok,
+        "hbm_budget_bytes": memwall.HBM_BUDGET_BYTES,
+        "anchor_nodes": anchor["n"] if anchor else None,
+        "anchor_platform": anchor["platform"] if anchor else None,
+        "anchor_variant": anchor["variant"] if anchor else None,
+        "anchor_peak_bytes": anchor["total_bytes"] if anchor else None,
+        "anchor_fits_budget": anchor.get("fits_budget") if anchor
+        else None,
+    }
+
+
 TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
             "ringp": functools.partial(bench_ring,
@@ -752,25 +859,33 @@ def run_tier_child(args) -> int:
 
         jax.config.update("jax_platforms", args.platform)
     # else ("default"/"auto"): leave the ambient platform alone.
-    if args._tier in ("telemetry", "profiler", "scenariobatch"):
+    if args._tier in ("telemetry", "profiler", "scenariobatch",
+                      "memwall"):
         # Artifact tiers share one shape: run a self-contained contract
-        # measurement (on/off overhead at the lean anchor, or the
-        # batched-vs-serial scenario fleet), persist the artifact.
+        # measurement (on/off overhead at the lean anchor, the
+        # batched-vs-serial scenario fleet, or the AOT memory-wall
+        # accounting), persist the artifact.
         fn = {"telemetry": bench_telemetry_overhead,
               "profiler": bench_profiler_overhead,
-              "scenariobatch": bench_scenario_batch}[args._tier]
-        artifact = ("scenariobatch_fleet.json"
-                    if args._tier == "scenariobatch"
-                    else f"{args._tier}_overhead.json")
+              "scenariobatch": bench_scenario_batch,
+              "memwall": bench_memwall}[args._tier]
+        artifact = {"scenariobatch": "scenariobatch_fleet.json",
+                    "memwall": "memwall_report.json"}.get(
+                        args._tier, f"{args._tier}_overhead.json")
         try:
             import jax
 
             res = fn(args.nodes, args.periods)
             ok = bool(res.pop("ok_parity", True))
             if not ok:
-                res["error"] = ("batched fleet diverged from serial "
-                                "(lane bitwise or verdict parity) — "
-                                "throughput not publishable")
+                res["error"] = (
+                    "streaming study diverged from the stacked path "
+                    "(milestone/series/state parity or donation wiring) "
+                    "— the compiled-shape rows are not publishable"
+                    if args._tier == "memwall" else
+                    "batched fleet diverged from serial "
+                    "(lane bitwise or verdict parity) — "
+                    "throughput not publishable")
             res.update(ok=ok, tier=args._tier,
                        platform_actual=jax.devices()[0].platform)
             path = os.path.join(
@@ -883,7 +998,7 @@ def main() -> int:
                     choices=("dense", "rumor", "shard", "ring", "ringp",
                              "ringpull", "ringshard", "ringshardc",
                              "telemetry", "profiler", "scenariobatch",
-                             "flagship", "both", "all"))
+                             "memwall", "flagship", "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
     ap.add_argument("--platform", default="auto",
@@ -961,6 +1076,12 @@ def main() -> int:
             # throughput-tier N sizing
             nodes = args.nodes
             p = args.periods or (12 if args.smoke else 0)
+        if tier == "memwall":
+            # AOT accounting sizes its own flagship-shape rows; the
+            # nodes arg only picks the CPU row's N (smoke-sized N also
+            # skips the minutes-long deviceless TPU compiles)
+            nodes = args.nodes or (4096 if args.smoke else 65_536)
+            p = args.periods or 12
         if tier in ("rumor", "shard") and nodes >= 262_144 \
                 and not args.periods:
             # The scatter-delivery engines serialize their updates on
@@ -1007,6 +1128,33 @@ def main() -> int:
                               f"{platform})"),
                    "value": 0.0, "unit": "arm-periods/sec",
                    "platform": platform, "error": r.get("error")}
+        out.update(info)
+        print(json.dumps(out))
+        return 0
+
+    if args.tier == "memwall":
+        # Accounting tier: the headline is the anchor shape's peak
+        # accounted bytes per device (argument + output + temp - alias).
+        # The *_peak_bytes / *_nodes pair below auto-registers with
+        # obs/trend.py, whose gate INVERTS for the bytes family — a
+        # memory regression is a RISE, gated exactly like a p/s drop.
+        r = results.get(args.tier, {})
+        if r.get("ok") and r.get("anchor_peak_bytes") is not None:
+            out = {"metric": (f"study peak bytes @ {r['anchor_nodes']} "
+                              f"nodes ({r['anchor_variant']} study, "
+                              f"{r['anchor_platform']} AOT "
+                              "memory_analysis)"),
+                   "value": r["anchor_peak_bytes"], "unit": "bytes",
+                   "platform": r["anchor_platform"],
+                   "memwall_nodes": r["anchor_nodes"],
+                   "memwall_peak_bytes": r["anchor_peak_bytes"]}
+            out.update({k: v for k, v in r.items() if k != "ok"})
+        else:
+            out = {"metric": f"study peak bytes (tier failed, {platform})",
+                   "value": -1.0, "unit": "bytes",
+                   "platform": platform, "error": r.get("error")}
+            out.update({k: v for k, v in r.items()
+                        if k not in ("ok", "error")})
         out.update(info)
         print(json.dumps(out))
         return 0

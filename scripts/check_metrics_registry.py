@@ -137,6 +137,59 @@ def check_prof_gauges() -> list[str]:
     return problems
 
 
+def check_mem_gauges() -> list[str]:
+    """Problems with the swim_mem_* gauge surface ([] = clean).
+
+    Two-sided, mirroring the prof/health lints: (a) the literal
+    `swim_mem_*` keys in memwall.gauge_values (AST source scan — a key
+    typo there would silently publish a zero) must be exactly
+    memwall.MEM_GAUGES; (b) render_memwall over a synthetic report must
+    emit exactly the MEM_GAUGES series (runtime render, the
+    check_health_gauges pattern — CI has no memwall artifact to render
+    otherwise).  Every name must be a legal Prometheus metric name.
+    """
+    import re
+
+    from swim_tpu.obs.expo import render_memwall
+    from swim_tpu.obs.memwall import MEM_GAUGES
+
+    problems: list[str] = []
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for name in MEM_GAUGES:
+        if not name_re.match(name):
+            problems.append(f"MEM_GAUGES entry {name!r} is not a legal "
+                            "Prometheus metric name")
+    mw_py = os.path.join(os.path.dirname(NODE_PY), os.pardir,
+                         "obs", "memwall.py")
+    with open(mw_py) as f:
+        tree = ast.parse(f.read(), filename=mw_py)
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "gauge_values"), None)
+    if fn is None:
+        problems.append("obs/memwall.py has no gauge_values()")
+    else:
+        written = {n.value for n in ast.walk(fn)
+                   if isinstance(n, ast.Constant)
+                   and isinstance(n.value, str)
+                   and n.value.startswith("swim_mem_")}
+        if written != set(MEM_GAUGES):
+            problems.append(
+                f"memwall.gauge_values writes {sorted(written)} but "
+                f"MEM_GAUGES declares {sorted(MEM_GAUGES)} — keep the "
+                "two in lockstep")
+    fake = {"n": 1, "state_bytes": 0, "hbm_budget_bytes": 1}
+    emitted = {line.split("{")[0].split(" ")[0]
+               for line in render_memwall(fake).splitlines()
+               if line and not line.startswith("#")}
+    if emitted != set(MEM_GAUGES):
+        problems.append(
+            f"render_memwall emits {sorted(emitted)} but MEM_GAUGES "
+            f"declares {sorted(MEM_GAUGES)} — keep the renderer and the "
+            "gauge table in lockstep")
+    return problems
+
+
 def check_scenario_rules() -> list[str]:
     """Problems with the scenario/health-rule surface ([] = clean).
 
@@ -218,11 +271,13 @@ def check_trend_tier_keys() -> list[str]:
     """Problems with the bench->trend key surface ([] = clean).
 
     The trend engine (obs/trend.py) auto-registers a tier series only
-    when a bench payload carries BOTH `<tier>_periods_per_sec` and
-    `<tier>_nodes`; a tier that emits one without the other silently
-    never trends.  For the special-cased artifact tiers (which bypass
-    the generic `{tier}_{key}` loop in bench.py main()), scan bench.py
-    source for explicitly written key literals and require the pair.
+    when a bench payload carries `<tier>_nodes` alongside a metric key —
+    `<tier>_periods_per_sec` (throughput family) or `<tier>_peak_bytes`
+    (memory family, gate direction inverted); a tier that emits one
+    without the other silently never trends.  For the special-cased
+    artifact tiers (which bypass the generic `{tier}_{key}` loop in
+    bench.py main()), scan bench.py source for explicitly written key
+    literals and require the pairing.
     """
     import re
 
@@ -231,6 +286,7 @@ def check_trend_tier_keys() -> list[str]:
     with open(bench_py) as f:
         src = f.read()
     pps = set(re.findall(r'"([a-z0-9]+)_periods_per_sec"', src))
+    peak = set(re.findall(r'"([a-z0-9]+)_peak_bytes"', src))
     nodes = set(re.findall(r'"([a-z0-9]+)_nodes"', src))
     problems: list[str] = []
     for tier in sorted(pps - nodes):
@@ -238,11 +294,16 @@ def check_trend_tier_keys() -> list[str]:
             f"bench.py writes \"{tier}_periods_per_sec\" but never "
             f"\"{tier}_nodes\" — the trend engine needs both to "
             "register the series")
-    for tier in sorted(nodes - pps):
+    for tier in sorted(peak - nodes):
         problems.append(
-            f"bench.py writes \"{tier}_nodes\" but never "
-            f"\"{tier}_periods_per_sec\" — the trend engine needs both "
-            "to register the series")
+            f"bench.py writes \"{tier}_peak_bytes\" but never "
+            f"\"{tier}_nodes\" — the trend engine needs both to "
+            "register the series")
+    for tier in sorted(nodes - (pps | peak)):
+        problems.append(
+            f"bench.py writes \"{tier}_nodes\" but no metric key "
+            f"(\"{tier}_periods_per_sec\" or \"{tier}_peak_bytes\") — "
+            "the trend engine needs the pair to register the series")
     return problems
 
 
@@ -275,6 +336,9 @@ def main() -> int:
     for problem in prof_problems:
         ok = False
         print(f"prof-gauge lint: {problem}", file=sys.stderr)
+    for problem in check_mem_gauges():
+        ok = False
+        print(f"mem-gauge lint: {problem}", file=sys.stderr)
     scenario_problems = check_scenario_rules()
     for problem in scenario_problems:
         ok = False
@@ -286,13 +350,15 @@ def main() -> int:
         ok = False
         print(f"trend-key lint: {problem}", file=sys.stderr)
     from swim_tpu.obs.health import HEALTH_RULES
+    from swim_tpu.obs.memwall import MEM_GAUGES
     from swim_tpu.obs.prof import PROF_GAUGES
     from swim_tpu.sim.scenario import LIBRARY
 
     print(f"checked {len(keys)} stats keys against "
           f"{len(NODE_COUNTERS)} declared counters, "
           f"{len(HEALTH_RULES)} health gauges, "
-          f"{len(PROF_GAUGES)} profiler gauges and "
+          f"{len(PROF_GAUGES)} profiler gauges, "
+          f"{len(MEM_GAUGES)} memory gauges and "
           f"{len(LIBRARY)} library scenarios: "
           f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1

@@ -27,8 +27,9 @@ captured artifacts also fails the run.
 
 `--fast` swaps the default pattern for FAST_FILES, a curated
 sub-5-minute smoke tier (host-side protocol logic, harness registries,
-roofline math, observability, bridge conformance, profiler contracts)
-for pre-push iteration; the full per-file suite stays the CI tier.
+roofline math, observability, bridge conformance, profiler contracts,
+memory-wall accounting + streaming-study parity) for pre-push
+iteration; the full per-file suite stays the CI tier.
 
 The SCENARIO gate (round 8): after the suite, FAST_SCENARIOS runs the
 library's sub-minute adversarial fault scenarios through `swim-tpu
@@ -65,6 +66,7 @@ FAST_FILES = (
     "tests/test_roofline.py",
     "tests/test_observatory.py",
     "tests/test_profiler.py",
+    "tests/test_memwall.py",
     "tests/test_bridge.py",
     "tests/test_graft_entry.py",
     "tests/test_sampling.py",

@@ -204,6 +204,29 @@ CAPTURES: list = [
       "--engine", "ring", "--periods", "12",
       "--crash-fraction", "0.00001", "--telemetry", "--flight-record",
       "bench_results/detection_10m_flight.jsonl"], 3600, False, None),
+    # Behind the one-chip memory wall (PR 13): 16M detection on a single
+    # chip via the streaming O(crashes) study driver + donated chunks.
+    # The deviceless-AOT verdict says this fits at 98.4% of HBM
+    # (bench_results/memwall_report.json); this row is the execution
+    # proof.  Checkpoint/resume is ON so a preempted capture resumes
+    # instead of restarting (snapshots are per-shard .npz under
+    # bench_results/ckpt_16m).
+    ("study_detection_16m",
+     ["-m", "swim_tpu.cli", "study", "detection", "--nodes", "16000000",
+      "--engine", "ring", "--periods", "12",
+      "--crash-fraction", "0.00001", "--stream", "on",
+      "--checkpoint-dir", "bench_results/ckpt_16m",
+      "--checkpoint-every", "4"], 7200, False, None),
+    # The 64M flagship: 4 chips of state on the v5e-8 mesh via the
+    # sharded ring engine (per-chip ~5.5G by the memwall ringshard row),
+    # streaming + per-shard checkpoints — the multi-chip headline run
+    # ROADMAP item 2 points at.
+    ("flagship_64m",
+     ["-m", "swim_tpu.cli", "study", "detection", "--nodes", "64000000",
+      "--engine", "ringshard", "--periods", "12",
+      "--crash-fraction", "0.00001", "--stream", "on",
+      "--checkpoint-dir", "bench_results/ckpt_64m",
+      "--checkpoint-every", "4"], 14400, False, None),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

@@ -215,6 +215,36 @@ def _cmd_simulate(args: argparse.Namespace) -> int:
 def _cmd_study(args: argparse.Namespace) -> int:
     from swim_tpu.sim import experiments
 
+    if args.mem_report:
+        if args.study != "detection":
+            print("error: --mem-report is a detection-study option",
+                  file=sys.stderr)
+            return 2
+        resolved = experiments.pick_engine(args.nodes, args.engine)
+        if args.engine != "auto" and not resolved.startswith("ring"):
+            print("error: --mem-report accounts the ring study "
+                  "pipeline; pass --engine ring or ringshard",
+                  file=sys.stderr)
+            return 2
+        from swim_tpu.obs import memwall
+
+        cfg_kw = {}
+        if args.sel_scope != "wave":
+            cfg_kw["ring_sel_scope"] = args.sel_scope
+        try:
+            report = memwall.study_memory_analysis(
+                args.nodes, periods=args.periods,
+                crash_fraction=args.crash_fraction,
+                variant="stacked" if args.stream == "off" else "stream",
+                engine=("ringshard" if resolved == "ringshard"
+                        else "ring"),
+                platform=args.mem_report,
+                probe=args.probe or "pull", **cfg_kw)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        print(json.dumps(report))
+        return 0
     kw = dict(n=args.nodes, periods=args.periods, seed=args.seed,
               engine=args.engine)
     if args.sel_scope != "wave":
@@ -239,8 +269,18 @@ def _cmd_study(args: argparse.Namespace) -> int:
             return 2
         kw["telemetry"] = True
         kw["flight_record"] = args.flight_record
+    if args.study != "detection" and (args.stream != "auto"
+                                      or args.checkpoint_dir):
+        print("error: --stream/--checkpoint-dir are detection-study "
+              "options", file=sys.stderr)
+        return 2
     if args.study == "detection":
         kw["crash_fraction"] = args.crash_fraction
+        if args.stream != "auto":
+            kw["stream"] = args.stream == "on"
+        if args.checkpoint_dir:
+            kw["checkpoint_dir"] = args.checkpoint_dir
+            kw["checkpoint_every"] = args.checkpoint_every
     elif args.study == "fp_sweep":
         if args.losses:
             kw["losses"] = tuple(args.losses)
@@ -588,6 +628,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "'rotor' to opt into the bounded-detection "
                          "throughput mode (deviation R1). Other "
                          "studies default to rotor.")
+    st.add_argument("--stream", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="detection study: drive the ring engines "
+                         "through the streaming O(crashes) milestone "
+                         "scan instead of the stacked [periods, N] "
+                         "track. 'auto' streams at >= 2M nodes (or "
+                         "whenever checkpointing is on); milestones "
+                         "and series are bitwise identical either way")
+    st.add_argument("--checkpoint-dir", default=None, metavar="DIR",
+                    help="detection study: per-shard mid-study "
+                         "checkpoints in DIR; when DIR already holds a "
+                         "snapshot the study RESUMES from it, bitwise "
+                         "identical to an uninterrupted run")
+    st.add_argument("--checkpoint-every", type=int, default=0,
+                    metavar="PERIODS",
+                    help="checkpoint cadence in periods (default: one "
+                         "snapshot per streaming chunk boundary)")
+    st.add_argument("--mem-report", choices=("cpu", "tpu"), default=None,
+                    help="don't run the study: AOT-compile its jitted "
+                         "step at this shape and print XLA's "
+                         "memory_analysis verdict against the one-chip "
+                         "HBM budget as JSON ('tpu' compiles against a "
+                         "deviceless v5e topology — the honest verdict; "
+                         "'cpu' works anywhere but double-counts the "
+                         "donated state)")
     st.set_defaults(fn=_cmd_study)
 
     ob = sub.add_parser(

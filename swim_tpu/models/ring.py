@@ -554,29 +554,54 @@ def live_knower_counts(cfg: SwimConfig, state: RingState,
     g = geometry(cfg)
     n = cfg.n_nodes
 
-    def counts_of(rows):                        # [cw, N] word-major
+    # Ordering token: the latest partial-count vector.  Every chunk's
+    # SOURCE matrix is rethreaded through an optimization_barrier against
+    # it before slicing, so chunk c+1's slice cannot be staged until
+    # chunk c's partial sum is done.  Without this chain the XLA:TPU
+    # latency-hiding scheduler hoists EVERY chunk slice ahead of the
+    # reductions — ~330 live u32[1, 2^23] buffers at 16M nodes, 5.4 GB
+    # of the 5.46 GB HLO temp that kept the 16M study 591 MB over one
+    # chip AFTER streaming milestones (memwall full-allocation capture;
+    # the committed study_detection_16m_oom.json shows the same site).
+    # The barrier is an identity: values, chunk boundaries and addition
+    # order are unchanged, so the census stays bitwise-identical.
+    tok = [None]
+
+    def chained(x):
+        if tok[0] is not None:
+            x, _ = jax.lax.optimization_barrier((x, tok[0]))
+        return x
+
+    # 2^23 word-node pairs x (4 B u32 bits + 4 B i32 masked) x 32 bits
+    # ~= 2 GiB of expanded intermediates per chunk
+    cw = chunk_words or max(1, pair_budget // max(n, 1))
+
+    def matrix_counts(words, nrows):            # [nrows, N] word-major
         # _lane_counts IS this census kernel; reuse it per chunk.
         # Beyond ~8.4M nodes even ONE word row exceeds the 2 GiB
         # budget (the 16M study OOM'd by 620 MB on exactly this), so
         # the node axis splits too — integer partial sums, bitwise-
         # identical in any split.
-        if rows.shape[0] * rows.shape[1] <= pair_budget:
-            return _lane_counts(rows, up).reshape(-1, WORD)
-        seg = max(1, pair_budget // rows.shape[0])
-        tot = None
-        for c in range(0, rows.shape[1], seg):
-            part = _lane_counts(rows[:, c:c + seg], up[c:c + seg])
-            tot = part if tot is None else tot + part
-        return tot.reshape(-1, WORD)
+        out = []
+        for r0 in range(0, nrows, cw):
+            rc = min(cw, nrows - r0)
+            if rc * n <= pair_budget:
+                tot = _lane_counts(chained(words)[r0:r0 + rc], up)
+            else:
+                seg = max(1, pair_budget // rc)
+                tot = None
+                for c0 in range(0, n, seg):
+                    part = _lane_counts(
+                        chained(words)[r0:r0 + rc, c0:c0 + seg],
+                        up[c0:c0 + seg])
+                    tot = part if tot is None else tot + part
+                    tok[0] = tot
+            tok[0] = tot
+            out.append(tot.reshape(-1, WORD))
+        return jnp.concatenate(out)
 
-    # 2^23 word-node pairs x (4 B u32 bits + 4 B i32 masked) x 32 bits
-    # ~= 2 GiB of expanded intermediates per chunk
-    cw = chunk_words or max(1, pair_budget // max(n, 1))
-    counts_cold = jnp.concatenate(
-        [counts_of(state.cold[c:c + cw]) for c in range(0, g.rw, cw)])
-    win_t = state.win.T                         # [WW, N]
-    counts_win = jnp.concatenate(
-        [counts_of(win_t[c:c + cw]) for c in range(0, g.ww, cw)])
+    counts_cold = matrix_counts(state.cold, g.rw)
+    counts_win = matrix_counts(state.win.T, g.ww)
     # overlay: window-resident ring words read their win column (cold's
     # copy of a window column is one generation stale by design)
     in_win, wcol = _window_overlay(g, state.step)
@@ -1406,7 +1431,16 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         src = draw_id(pr.src_u[:, 0])
         src_ok = ops.gather_nodewise(active, src)
         for a in range(1, PULL_SRC_ATTEMPTS):
-            nxt = draw_id(pr.src_u[:, a])
+            # Attempts are sequential by meaning (attempt a only matters
+            # when a-1 missed a live peer), but nothing in the dataflow
+            # says so, and XLA's scheduler issues every draw_id/gather
+            # up front — PULL_SRC_ATTEMPTS concurrent [N] temps in the
+            # 16M memwall capture.  Threading src_ok through the next
+            # draw's uniforms (identity barrier, bitwise-neutral) keeps
+            # one attempt in flight at a time.
+            u_a, _ = jax.lax.optimization_barrier(
+                (pr.src_u[:, a], src_ok))
+            nxt = draw_id(u_a)
             src = jnp.where(src_ok, src, nxt)
             src_ok = src_ok | ops.gather_nodewise(active, nxt)
         probe_live = probed & src_ok
@@ -1443,6 +1477,16 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
             px_deliver = px_deliver | w4_ok
             relayed_lane = relayed_lane | (
                 w4_ok & (pr.px_back[:, b] >= thr2))
+        # The three [N, WW] selection-row gathers below (direct, proxy,
+        # ack-pull) each produce a ~1GB result at 16M nodes that the
+        # following OR consumes immediately — but gather k+1 has no data
+        # dependence on OR k, so the latency-hiding scheduler issues all
+        # three up front and holds ~3GB of gather results live at peak
+        # (the dominant HLO-temp terms of the 16M one-chip capture).
+        # Threading the accumulated `win` through the next gather's index
+        # via an optimization_barrier (identity op, bitwise-neutral)
+        # serializes them: peak holds ONE gather result at a time.
+        px_src, win = jax.lax.optimization_barrier((px_src, win))
         win = win | jnp.where(px_deliver[:, None],
                               ops.gather_rows(sel_all, px_src),
                               jnp.uint32(0))
@@ -1454,8 +1498,9 @@ def step(cfg: SwimConfig, state: RingState, plan: FaultPlan,
         ack_gossip_ok = (active & ops.gather_nodewise(active, aq)
                          & ~(part_on & (pid != pid_of(aq)))
                          & (pr.ack_leg >= thr2))
+        aq_g, win = jax.lax.optimization_barrier((aq, win))
         win = win | jnp.where(ack_gossip_ok[:, None],
-                              ops.gather_rows(sel_all, aq),
+                              ops.gather_rows(sel_all, aq_g),
                               jnp.uint32(0))
         if prof is not None and prof.cut(
                 "merge", win, ops=ops, win=win, acked=acked_lane,

@@ -218,3 +218,30 @@ def render_profile(report: dict,
                  f"{_fmt_float(report.get('coverage_pct', 0.0))}")
     assert set(help_txt) == set(PROF_GAUGES)
     return "\n".join(lines) + "\n"
+
+
+def render_memwall(report: dict,
+                   labels: dict[str, str] | None = None) -> str:
+    """One obs/memwall.py AOT memory report as swim_mem_* gauges (names
+    pinned in memwall.MEM_GAUGES and linted against this renderer by
+    scripts/check_metrics_registry.py).  Like profile reports these are
+    point-in-time artifacts, so every series carries the analyzed shape
+    (nodes), compile platform, and program variant as labels — a 16M
+    stream analysis and a 64M sharded one never alias."""
+    # import-time jax-free: memwall.py defers jax to call time
+    from swim_tpu.obs.memwall import MEM_GAUGES, gauge_values
+
+    base = {**(labels or {}),
+            "nodes": str(report.get("n", "?")),
+            "platform": str(report.get("platform", "?")),
+            "variant": str(report.get("variant", "?")),
+            "engine": str(report.get("engine", "?"))}
+    lines: list[str] = []
+    values = gauge_values(report)
+    for full, help_text in MEM_GAUGES.items():
+        lines.append(f"# HELP {full} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full}{_fmt_labels(base)} "
+                     f"{_fmt_float(values[full])}")
+    assert set(values) == set(MEM_GAUGES)
+    return "\n".join(lines) + "\n"

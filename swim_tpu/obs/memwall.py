@@ -1,0 +1,240 @@
+"""Memory-wall accounting: AOT `memory_analysis` of the study pipeline.
+
+The 16M detection study died on its *own temporaries*, 622M over the
+15.75G one-chip HBM budget (bench_results/study_detection_16m_oom.json),
+while the plain 16M bench row fit — the wall was the study runner, not
+the engine. This module makes that budget a measured, regression-gated
+number that needs no hardware: `jax.jit(...).lower(shapes).compile()
+.memory_analysis()` returns XLA's buffer-assignment totals (argument /
+output / temp / alias bytes) for the exact program the study would run,
+against nothing but ShapeDtypeStructs.
+
+Two compile targets:
+
+  * platform="cpu" — the host backend. Always available, but XLA:CPU
+    materializes a full second copy of the engine state inside the step
+    (no in-place update of the big heard-bit planes), so its totals
+    overstate the device peak by ~1× state.
+  * platform="tpu" — DEVICELESS XLA:TPU via
+    `jax.experimental.topologies.get_topology_desc` (libtpu compiles
+    without hardware). This is the same compiler whose compile-time HBM
+    check produced the committed OOM artifact, so its verdict — either
+    buffer totals under budget or a compile-time OOM error — is the
+    one-chip claim itself, reproducible on any CPU host. A program
+    replicated over the topology reports per-device bytes, i.e. the
+    single-chip footprint.
+
+`engine="ringshard"` additionally lowers the study against the sharded
+ring's placement specs (parallel/ring_shard._state_specs) over the
+topology mesh — the per-chip accounting of the 64M+ flagship.
+
+Exposed as `bench.py --tier memwall` (committed artifact + trend-gated
+peak bytes) and `swim-tpu study --mem-report` (ad-hoc, any shape).
+Import-time jax-free like the other obs modules; jax loads on use.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+# One v5e chip's usable HBM — the denominator the 16M OOM was measured
+# against ("16.36G of 15.75G hbm", study_detection_16m_oom.json).
+HBM_BUDGET_BYTES = int(15.75 * 2**30)
+
+DEFAULT_TOPOLOGY = "v5e:2x4"
+
+# Prometheus gauge registry for the exposition side (obs/expo.py
+# render_memwall). scripts/check_metrics_registry.py lints the two
+# against each other the same way it does swim_prof_*.
+MEM_GAUGES = {
+    "swim_mem_argument_bytes": "XLA argument buffer bytes (engine state + "
+                               "plan + milestone carry) of the study step",
+    "swim_mem_output_bytes": "XLA output buffer bytes of the study step",
+    "swim_mem_temp_bytes": "XLA temporary buffer bytes of the study step",
+    "swim_mem_alias_bytes": "bytes aliased by donation (input buffers "
+                            "reused as outputs)",
+    "swim_mem_total_bytes": "peak accounted bytes per device: argument + "
+                            "output + temp - alias",
+    "swim_mem_state_bytes": "engine-state bytes alone (the sharded term "
+                            "of the flagship budget)",
+    "swim_mem_hbm_budget_bytes": "one-chip HBM budget the verdict is "
+                                 "measured against",
+    "swim_mem_fits_budget": "1 when total fits the one-chip budget, "
+                            "else 0",
+}
+
+
+def _tree_bytes(shapes: Any) -> int:
+    import jax
+
+    return sum(int(x.size) * x.dtype.itemsize
+               for x in jax.tree.leaves(shapes))
+
+
+def _tpu_topology_mesh(topology: str):
+    """Deviceless TPU mesh over a topology descriptor. libtpu insists on
+    probing GCP instance metadata unless told not to — pin the env so
+    this works on any laptop/CI host (no-ops on a real TPU VM where the
+    vars are already set)."""
+    import numpy as np
+    import jax
+    from jax.experimental import topologies
+
+    os.environ.setdefault("TPU_SKIP_MDS_QUERY", "true")
+    os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    os.environ.setdefault("TPU_WORKER_ID", "0")
+    os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name=topology)
+    from swim_tpu.parallel import mesh as pmesh
+
+    return jax.sharding.Mesh(np.array(topo.devices), (pmesh.NODE_AXIS,))
+
+
+def _oom_details(err: str) -> dict:
+    """Fold a compile-time HBM OOM into report fields (the TPU compiler
+    rejects over-budget programs at compile time — that rejection IS the
+    measurement, same shape as the committed 16M OOM artifact)."""
+    return {
+        "compile_oom": True,
+        "fits_budget": False,
+        "error": " ".join(err.split())[:600],
+    }
+
+
+def study_memory_analysis(n: int, periods: int = 12,
+                          crash_fraction: float = 1e-5, *,
+                          variant: str = "stream", engine: str = "ring",
+                          platform: str = "cpu",
+                          topology: str = DEFAULT_TOPOLOGY,
+                          probe: str = "pull",
+                          budget_bytes: int = HBM_BUDGET_BYTES,
+                          **cfg_kw) -> dict:
+    """AOT memory accounting of one detection-study program at `n`-node
+    shapes. Nothing is allocated at size N: state/plan/track enter as
+    ShapeDtypeStructs and only the compiled executable's buffer
+    assignment is read back.
+
+    `variant` picks the program: "stream" is the O(crashes) chunked
+    study step (runner._run_study_ring_chunk, state AND track donated);
+    "stacked" is the full-track run_study_ring — the pre-streaming
+    baseline, kept lowerable so the before/after contrast stays
+    measurable at any shape. `engine="ringshard"` (tpu only) lowers
+    against the sharded placement specs, reporting per-chip bytes."""
+    import jax
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import ring
+    from swim_tpu.sim import faults, runner
+
+    if variant not in ("stream", "stacked"):
+        raise ValueError(f"unknown memwall variant {variant!r}")
+    if engine not in ("ring", "ringshard"):
+        raise ValueError(f"unknown memwall engine {engine!r}")
+    if platform not in ("cpu", "tpu"):
+        raise ValueError(f"unknown memwall platform {platform!r}")
+    if engine == "ringshard" and (platform != "tpu" or variant != "stream"):
+        raise ValueError("ringshard memory analysis needs platform='tpu' "
+                         "and variant='stream' (the flagship program)")
+    cfg_kw.setdefault("ring_probe", probe)
+    cfg = SwimConfig(n_nodes=n, **cfg_kw)
+    state_sd = jax.eval_shape(lambda: ring.init_state(cfg))
+    plan_sd = jax.eval_shape(lambda: faults.none(n))
+    key_sd = jax.eval_shape(lambda: jax.random.key(0))
+    crashes = max(1, round(n * crash_fraction))
+    i32 = jax.ShapeDtypeStruct((crashes,), "int32")
+    track_sd = runner.CompactTrack(i32, i32, i32, i32, i32)
+    carry_sd = (state_sd, track_sd) if variant == "stream" else state_sd
+
+    report = {
+        "n": int(n),
+        "periods": int(periods),
+        "crashes": int(crashes),
+        "variant": variant,
+        "engine": engine,
+        "platform": platform,
+        "ring_probe": cfg.ring_probe,
+        "state_bytes": _tree_bytes(state_sd),
+        "carry_bytes": _tree_bytes(carry_sd),
+        "hbm_budget_bytes": int(budget_bytes),
+    }
+
+    step_fn = None
+    if platform == "tpu":
+        mesh = _tpu_topology_mesh(topology)
+        report["topology"] = topology
+        report["devices"] = len(mesh.devices.flat)
+        rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+        if engine == "ringshard":
+            from swim_tpu.parallel import ring_shard
+
+            ring_shard._check(cfg, mesh)
+            spec_of = lambda tree: jax.tree.map(  # noqa: E731
+                lambda sp: jax.sharding.NamedSharding(mesh, sp), tree)
+            state_sh = spec_of(ring_shard._state_specs(cfg))
+            plan_sh = spec_of(ring_shard._plan_specs())
+            step_fn = ring_shard.mapped_step(cfg, mesh)
+        else:
+            state_sh = rep
+            plan_sh = rep
+        in_sh = ((state_sh, rep, plan_sh, rep) if variant == "stream"
+                 else (state_sh, plan_sh, rep))
+        if variant == "stream":
+            fn = jax.jit(runner._run_study_ring_chunk.__wrapped__,
+                         static_argnums=(0, 5, 6), donate_argnums=(1, 2),
+                         in_shardings=in_sh)
+            args = (cfg, state_sd, track_sd, plan_sd, key_sd, periods,
+                    step_fn)
+        else:
+            fn = jax.jit(runner.run_study_ring.__wrapped__,
+                         static_argnums=(0, 4, 5), donate_argnums=(1,),
+                         in_shardings=in_sh)
+            args = (cfg, state_sd, plan_sd, key_sd, periods, None)
+    else:
+        if variant == "stream":
+            fn = runner._run_study_ring_chunk
+            args = (cfg, state_sd, track_sd, plan_sd, key_sd, periods, None)
+        else:
+            fn = runner.run_study_ring
+            args = (cfg, state_sd, plan_sd, key_sd, periods, None)
+
+    try:
+        ma = fn.lower(*args).compile().memory_analysis()
+    except Exception as e:  # compile-time HBM OOM is a result, not a crash
+        msg = str(e)
+        if "hbm" in msg.lower() or "RESOURCE_EXHAUSTED" in msg:
+            report.update(_oom_details(msg))
+            return report
+        raise
+    arg = int(ma.argument_size_in_bytes)
+    out = int(ma.output_size_in_bytes)
+    temp = int(ma.temp_size_in_bytes)
+    alias = int(ma.alias_size_in_bytes)
+    total = arg + out + temp - alias
+    report.update({
+        "compile_oom": False,
+        "argument_bytes": arg,
+        "output_bytes": out,
+        "temp_bytes": temp,
+        "alias_bytes": alias,
+        "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        "total_bytes": total,
+        "budget_fraction": total / budget_bytes,
+        "fits_budget": bool(total <= budget_bytes),
+    })
+    return report
+
+
+def gauge_values(report: dict) -> dict[str, float]:
+    """MEM_GAUGES name → value for one report (exposition + lint glue)."""
+    return {
+        "swim_mem_argument_bytes": float(report.get("argument_bytes", 0)),
+        "swim_mem_output_bytes": float(report.get("output_bytes", 0)),
+        "swim_mem_temp_bytes": float(report.get("temp_bytes", 0)),
+        "swim_mem_alias_bytes": float(report.get("alias_bytes", 0)),
+        "swim_mem_total_bytes": float(report.get("total_bytes", 0)),
+        "swim_mem_state_bytes": float(report["state_bytes"]),
+        "swim_mem_hbm_budget_bytes": float(report["hbm_budget_bytes"]),
+        "swim_mem_fits_budget": 1.0 if report.get("fits_budget") else 0.0,
+    }

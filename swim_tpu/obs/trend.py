@@ -35,6 +35,10 @@ import sys
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 _PPS_SUFFIX = "_periods_per_sec"
+# Second metric family: peak memory bytes (bench.py --tier memwall).
+# Same auto-registration (`<tier>_peak_bytes` + `<tier>_nodes`), but the
+# gate direction INVERTS — bytes regress by RISING, p/s by dropping.
+_BYTES_SUFFIX = "_peak_bytes"
 
 DEFAULT_THRESHOLD = 0.10
 
@@ -52,15 +56,20 @@ def _samples_from_parsed(parsed: dict, *, source: str, rnd: int | None,
         or "unknown"
     out = []
     for key, val in parsed.items():
-        if not key.endswith(_PPS_SUFFIX) or not isinstance(
-                val, (int, float)):
+        if not isinstance(val, (int, float)):
             continue
-        tier = key[:-len(_PPS_SUFFIX)]
+        if key.endswith(_PPS_SUFFIX):
+            tier, metric = key[:-len(_PPS_SUFFIX)], "pps"
+        elif key.endswith(_BYTES_SUFFIX):
+            tier, metric = key[:-len(_BYTES_SUFFIX)], "peak_bytes"
+        else:
+            continue
         nodes = parsed.get(f"{tier}_nodes")
         out.append({
             "tier": tier,
             "nodes": int(nodes) if isinstance(nodes, (int, float)) else None,
             "platform": str(platform),
+            "metric": metric,
             "pps": float(val),
             "round": rnd,
             "captured_at": captured_at,
@@ -102,12 +111,13 @@ def collect(repo: str | None = None) -> list[dict]:
 
 
 def series(samples: list[dict]) -> dict[tuple, list[dict]]:
-    """Group by (tier, nodes, platform); each series ordered with
-    rounds first (numeric) then round-less captures by captured_at."""
+    """Group by (tier, nodes, platform, metric); each series ordered
+    with rounds first (numeric) then round-less captures by
+    captured_at."""
     out: dict[tuple, list[dict]] = {}
     for s in samples:
-        out.setdefault((s["tier"], s["nodes"], s["platform"]),
-                       []).append(s)
+        out.setdefault((s["tier"], s["nodes"], s["platform"],
+                        s.get("metric", "pps")), []).append(s)
     for key in out:
         out[key].sort(key=lambda s: (
             0 if s["round"] is not None else 1,
@@ -122,13 +132,15 @@ def check(ser: dict[tuple, list[dict]],
 
     Last-good semantics (bench.py's last_good_tpu vocabulary): the
     latest round is judged against the IMMEDIATELY PREVIOUS round, and
-    fails (ok=False) when it drops more than `threshold` below it.
-    CPU proxy numbers are noisy round to round, so judging against the
-    all-time best would permanently fail a series after one lucky
-    round; the full trajectory stays visible in render() either way.
-    Series with fewer than two round samples pass vacuously."""
+    fails (ok=False) when it regresses more than `threshold` past it —
+    a DROP for pps series, a RISE for peak_bytes series (memory
+    regresses upward).  CPU proxy numbers are noisy round to round, so
+    judging against the all-time best would permanently fail a series
+    after one lucky round; the full trajectory stays visible in
+    render() either way.  Series with fewer than two round samples pass
+    vacuously."""
     findings = []
-    for (tier, nodes, platform), samp in sorted(
+    for (tier, nodes, platform, metric), samp in sorted(
             ser.items(), key=lambda kv: str(kv[0])):
         rounds = [s for s in samp if s["round"] is not None]
         if len(rounds) < 2:
@@ -136,14 +148,16 @@ def check(ser: dict[tuple, list[dict]],
         latest, last_good = rounds[-1], rounds[-2]
         drop = 1.0 - latest["pps"] / last_good["pps"] \
             if last_good["pps"] > 0 else 0.0
+        regression = -drop if metric == "peak_bytes" else drop
         findings.append({
             "tier": tier, "nodes": nodes, "platform": platform,
+            "metric": metric,
             "latest_round": latest["round"], "latest_pps": latest["pps"],
             "last_good_round": last_good["round"],
             "last_good_pps": last_good["pps"],
             "drop_pct": round(drop * 100.0, 2),
             "threshold_pct": round(threshold * 100.0, 2),
-            "ok": drop <= threshold,
+            "ok": regression <= threshold,
         })
     return findings
 
@@ -154,11 +168,12 @@ def summarize(repo: str | None = None,
     findings = check(ser, threshold)
     return {
         "series": {
-            f"{tier}@{nodes}/{platform}": [
+            f"{tier}@{nodes}/{platform}"
+            + ("" if metric == "pps" else f" [{metric}]"): [
                 {"round": s["round"], "captured_at": s["captured_at"],
                  "pps": s["pps"], "source": s["source"]}
                 for s in samp]
-            for (tier, nodes, platform), samp in sorted(
+            for (tier, nodes, platform, metric), samp in sorted(
                 ser.items(), key=lambda kv: str(kv[0]))
         },
         "checks": findings,
@@ -179,8 +194,11 @@ def render(summary: dict) -> str:
         lines.append("gate: no series with >= 2 rounds; nothing to check")
     for f in summary["checks"]:
         tag = "ok  " if f["ok"] else "FAIL"
+        metric = f.get("metric", "pps")
+        name = f"{f['tier']}@{f['nodes']}/{f['platform']}" \
+            + ("" if metric == "pps" else f" [{metric}]")
         lines.append(
-            f"  [{tag}] {f['tier']}@{f['nodes']}/{f['platform']}: "
+            f"  [{tag}] {name}: "
             f"r{f['latest_round']} {f['latest_pps']:g} vs last-good "
             f"r{f['last_good_round']} {f['last_good_pps']:g} "
             f"(drop {f['drop_pct']}%, limit {f['threshold_pct']}%)")

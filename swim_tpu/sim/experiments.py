@@ -34,6 +34,14 @@ from swim_tpu.utils import metrics
 
 DENSE_MAX = 8192
 
+# detection_study(stream="auto") switches the ring engines to the
+# streaming O(crashes) study driver at and above this N: below it the
+# stacked [periods, N] track is cheap and keeps the exact historical
+# code path; above it the stacked track is what broke the one-chip
+# memory wall (bench_results/study_detection_16m_oom.json).  Both paths
+# are bitwise-identical on milestones and series (tests/test_memwall.py).
+STREAM_AUTO_NODES = 2_000_000
+
 
 def pick_engine(n: int, engine: str = "auto") -> str:
     if engine != "auto":
@@ -59,7 +67,12 @@ def _mapped_step(cfg: SwimConfig, mesh, program: bool = False):
 
 
 def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
-               periods: int, engine: str):
+               periods: int, engine: str, stream: bool = False,
+               ckpt=None, chunk: int = 0):
+    if stream and engine not in ("ring", "ringshard"):
+        raise ValueError(
+            f"streaming studies cover the ring engines only, not "
+            f"'{engine}'")
     mesh = pmesh.make_mesh()
     n = cfg.n_nodes
     if engine == "shard":
@@ -76,10 +89,14 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
 
         state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
                                        plan)
-        return runner.run_study_ring(
-            cfg, state, plan, key, periods,
-            _mapped_step(cfg, mesh,
-                         isinstance(plan, faults.FaultProgram)))
+        step_fn = _mapped_step(cfg, mesh,
+                               isinstance(plan, faults.FaultProgram))
+        if stream:
+            return runner.run_study_ring_stream(
+                cfg, state, plan, key, periods, step_fn, chunk=chunk,
+                ckpt=ckpt)
+        return runner.run_study_ring(cfg, state, plan, key, periods,
+                                     step_fn)
     plan = pmesh.shard_state(plan, mesh, n=n)
     if engine == "dense":
         state = pmesh.shard_state(dense.init_state(cfg), mesh, n=n)
@@ -88,6 +105,9 @@ def _run_study(cfg: SwimConfig, plan: faults.FaultPlan, key: jax.Array,
         from swim_tpu.models import ring
 
         state = pmesh.shard_state(ring.init_state(cfg), mesh, n=n)
+        if stream:
+            return runner.run_study_ring_stream(
+                cfg, state, plan, key, periods, chunk=chunk, ckpt=ckpt)
         return runner.run_study_ring(cfg, state, plan, key, periods)
     state = pmesh.shard_state(rumor.init_state(cfg), mesh, n=n)
     return runner.run_study_rumor(cfg, state, plan, key, periods)
@@ -163,6 +183,10 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
                     periods: int = 100, seed: int = 0,
                     engine: str = "auto",
                     flight_record: str | None = None,
+                    stream: bool | str = "auto",
+                    checkpoint_dir: str | None = None,
+                    checkpoint_every: int = 0,
+                    chunk: int = 0,
                     **cfg_kw) -> dict[str, Any]:
     """Config 2: crash-stop injection → detection-time distribution.
 
@@ -190,16 +214,37 @@ def detection_study(n: int = 1000, crash_fraction: float = 0.01,
         # (ring_probe="rotor") and remains the default everywhere else.
         cfg_kw.setdefault("ring_probe", "pull")
     cfg = SwimConfig(n_nodes=n, **cfg_kw)
+    # stream="auto": milestones are bitwise-identical either way, so the
+    # study switches to the O(crashes) streaming driver exactly where
+    # the stacked [periods, N] track starts to matter for HBM — or
+    # whenever checkpointing is requested (only the streaming driver
+    # checkpoints).  stream=True/False forces the path (tests pin both).
+    if isinstance(stream, bool):
+        do_stream = stream
+    else:
+        do_stream = (engine in ("ring", "ringshard")
+                     and (n >= STREAM_AUTO_NODES
+                          or checkpoint_dir is not None))
+    ckpt = None
+    if checkpoint_dir is not None:
+        if not do_stream:
+            raise ValueError("checkpointing needs the streaming study "
+                             "driver; pass stream='auto' or stream=True")
+        ckpt = runner.StudyCheckpointer(checkpoint_dir,
+                                        every=checkpoint_every)
     plan = faults.with_random_crashes(
         faults.none(n), jax.random.key(seed + 1), crash_fraction,
         2, max(3, periods // 2))
-    res = _run_study(cfg, plan, jax.random.key(seed), periods, engine)
+    res = _run_study(cfg, plan, jax.random.key(seed), periods, engine,
+                     stream=do_stream, ckpt=ckpt, chunk=chunk)
     out = {"study": "detection", "n": n, "periods": periods,
            "engine": engine, "crash_fraction": crash_fraction,
            "suspicion_periods": cfg.suspicion_periods}
     if engine in ("ring", "ringshard"):
         # self-describing: which probe regime produced these latencies
+        # and which study driver (stream: O(crashes) milestone track)
         out["ring_probe"] = cfg.ring_probe
+        out["stream"] = bool(do_stream)
     out.update(runner.detection_summary(res, plan, periods))
     out.update(metrics.series_digest(res.series))
     if engine in ("rumor", "shard", "ring", "ringshard"):

@@ -21,6 +21,7 @@ milestones are one scatter over the (tiny) rumor table.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, NamedTuple
 
 import jax
@@ -33,6 +34,7 @@ from swim_tpu.obs.engine import frame_from_tap
 from swim_tpu.ops import lattice
 from swim_tpu.sim import faults
 from swim_tpu.sim.faults import FaultPlan
+from swim_tpu.utils import checkpoint
 from swim_tpu.utils.prng import draw_period
 
 NEVER = jnp.int32(2**31 - 1)
@@ -136,6 +138,21 @@ class RumorStudyResult(NamedTuple):
     telemetry: Any = None
 
 
+def _view_counts(subject, rkey, knowers, up, gone_dead):
+    """Knower-weighted (suspect, dead) view counts over the rumor table
+    plus the dissemination floor — shared by the full and streaming
+    study bodies."""
+    used = subject >= 0
+    live_total = jnp.sum(up).astype(jnp.int32)
+    is_s = lattice.is_suspect(rkey)
+    is_d = lattice.is_dead(rkey)
+    return (
+        jnp.sum(jnp.where(used & is_s, knowers, 0)).astype(jnp.int32),
+        jnp.sum(jnp.where(used & is_d, knowers, 0)).astype(jnp.int32)
+        + jnp.sum(gone_dead) * live_total,
+    )
+
+
 def _subject_flags(n: int, subject, rkey, knowers, up,
                    gone_not_alive, gone_dead):
     """Per-subject (not-alive-seen, dead-seen, dead-disseminated) bool[N]
@@ -147,6 +164,17 @@ def _subject_flags(n: int, subject, rkey, knowers, up,
     dissemination floor. `gone_not_alive`/`gone_dead` split because the
     ring engine's floor can hold ALIVE/SUSPECT keys (any disseminated
     retired key) while the rumor engine's holds only death tombstones.
+
+    The three flags ride ONE u8 verdict lane (bit0 = not-alive seen,
+    bit1 = dead seen, bit2 = disseminated) written by a single
+    scatter-max, instead of three parallel bool[N] scatters — the same
+    narrow-at-source move as ops/wavepack.py, and the fix for the
+    duplicated pred[N] fusions the 16M study OOM HLO showed
+    (study_detection_16m_oom.json). Scatter-max equals the per-bit
+    scatter-OR because within one period the slot codes form a chain:
+    with live observers, bit2 ⇒ knowers ≥ live_total > 0 ⇒ known, and
+    dead ⇒ not-alive, so codes ∈ {0, 1, 3, 7}; with live_total == 0,
+    `known` is all-False and codes ∈ {0, 4}.
     """
     used = subject >= 0
     live_total = jnp.sum(up).astype(jnp.int32)
@@ -154,17 +182,19 @@ def _subject_flags(n: int, subject, rkey, knowers, up,
     is_d = lattice.is_dead(rkey)
     known = used & (knowers > 0)
     sub = jnp.where(used, subject, n)
-    zeros = jnp.zeros((n,), jnp.bool_)
-    not_alive = (zeros.at[sub].max(known & (is_s | is_d), mode="drop")
-                 | gone_not_alive)
-    dead_seen = zeros.at[sub].max(known & is_d, mode="drop") | gone_dead
-    dead_all = (zeros.at[sub].max(used & is_d & (knowers >= live_total),
-                                  mode="drop") | gone_dead)
-    counts = (
-        jnp.sum(jnp.where(used & is_s, knowers, 0)).astype(jnp.int32),
-        jnp.sum(jnp.where(used & is_d, knowers, 0)).astype(jnp.int32)
-        + jnp.sum(gone_dead) * live_total,
-    )
+    code = ((known & (is_s | is_d)).astype(jnp.uint8)
+            | (known & is_d).astype(jnp.uint8) << 1
+            | (used & is_d & (knowers >= live_total)).astype(jnp.uint8) << 2)
+    verdict = jnp.zeros((n,), jnp.uint8).at[sub].max(code, mode="drop")
+    # the floor ORs in elementwise: a dead floor key marks all three
+    # milestones (dead ⊂ not-alive), any floor key marks not-alive
+    verdict = (verdict
+               | jnp.where(gone_not_alive, jnp.uint8(1), jnp.uint8(0))
+               | jnp.where(gone_dead, jnp.uint8(6), jnp.uint8(0)))
+    not_alive = (verdict & 1) > 0
+    dead_seen = (verdict & 2) > 0
+    dead_all = (verdict & 4) > 0
+    counts = _view_counts(subject, rkey, knowers, up, gone_dead)
     return not_alive, dead_seen, dead_all, counts
 
 
@@ -344,6 +374,258 @@ def run_study_ring(cfg: SwimConfig, state, plan: FaultPlan,
 
 
 # ---------------------------------------------------------------------------
+# Streaming studies: O(crashes) milestone extraction folded into the scan
+# carry.  The full-track path above carries 3× i32[N] milestone lanes and
+# scatters bool[N] flags every period — 192 MB of carry plus scatter
+# buffers at 16M nodes, a big slice of the 622M the 16M study OOM'd by
+# (study_detection_16m_oom.json).  A detection study only ever *reads*
+# milestones of crashed subjects (study_milestones restricts to
+# crash < periods), and the crash schedule is host-known before the scan,
+# so the streaming path precomputes the crashed-subject list once and
+# carries [C]-sized lanes instead (C = crashes, ~160 at 16M with
+# crash_fraction 1e-5).  Per period, subject matching is a [C, R] compare
+# against the (tiny) rumor table plus [C] gathers from the dissemination
+# floor — no N-sized scatter at all.  Bitwise parity with the stacked
+# path is pinned by tests/test_memwall.py.
+#
+# The driver is chunked: the jitted chunk donates BOTH the engine state
+# and the track carry, and the host loop between chunks is where
+# mid-study checkpoints happen (per-period randomness is
+# fold_in(root_key, st.step), and st.step rides in the state, so a
+# chunked scan is bitwise-identical to one scan and a resumed run is
+# bitwise-identical to an uninterrupted one).
+# ---------------------------------------------------------------------------
+
+
+class CompactTrack(NamedTuple):
+    """Detection milestones restricted to crashed subjects (i32[C])."""
+
+    subjects: jax.Array      # node ids with crash_step < periods, ascending
+    crash_step: jax.Array    # their crash periods
+    first_suspect: jax.Array
+    first_dead_view: jax.Array
+    disseminated: jax.Array
+
+
+def compact_track_init(plan: FaultPlan, periods: int) -> CompactTrack:
+    """Host-side: enumerate the subjects that can crash within the study
+    window. np.where order (ascending node id) matches the restriction
+    order of the full path's study_milestones, so summaries agree."""
+    base = faults.base_of(plan)
+    crash = np.asarray(jax.device_get(base.crash_step))
+    subjects = np.flatnonzero(crash < periods).astype(np.int32)
+    c = subjects.size
+    # three DISTINCT buffers: the chunk donates each milestone lane, and
+    # donating one shared buffer three times is an XLA error
+    return CompactTrack(
+        subjects=jnp.asarray(subjects),
+        crash_step=jnp.asarray(crash[subjects].astype(np.int32)),
+        first_suspect=jnp.full((c,), NEVER, jnp.int32),
+        first_dead_view=jnp.full((c,), NEVER, jnp.int32),
+        disseminated=jnp.full((c,), NEVER, jnp.int32),
+    )
+
+
+def _compact_subject_flags(subjects, subject, rkey, knowers, up,
+                           gone_not_alive, gone_dead):
+    """_subject_flags restricted to the crashed-subject list: a [C, R]
+    compare against the rumor table plus [C] floor gathers, instead of
+    bool[N] scatters. Value-identical to gathering the full flags at
+    `subjects` (the parity the streaming tests pin)."""
+    used = subject >= 0
+    live_total = jnp.sum(up).astype(jnp.int32)
+    is_s = lattice.is_suspect(rkey)
+    is_d = lattice.is_dead(rkey)
+    known = used & (knowers > 0)
+    eq = subject[None, :] == subjects[:, None]  # [C, R]
+
+    def hit(pred):
+        return jnp.any(eq & pred[None, :], axis=1)
+
+    not_alive = hit(known & (is_s | is_d)) | gone_not_alive[subjects]
+    dead_seen = hit(known & is_d) | gone_dead[subjects]
+    dead_all = (hit(used & is_d & (knowers >= live_total))
+                | gone_dead[subjects])
+    return not_alive, dead_seen, dead_all
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5, 6),
+                   donate_argnums=(1, 2))
+def _run_study_ring_chunk(cfg: SwimConfig, state, track: CompactTrack,
+                          plan: FaultPlan, root_key: jax.Array,
+                          periods: int, step_fn=None):
+    """Advance `periods` periods of a streaming ring study. Donates the
+    engine state AND the milestone carry — between chunks exactly one
+    copy of each lives in HBM. The period clock is state.step, so
+    chaining chunks reproduces one long scan bitwise."""
+    from swim_tpu.models import ring as ring_mod
+
+    def body(carry, _):
+        st, tr = carry
+        rnd = ring_mod.draw_period_ring(root_key, st.step, cfg)
+        frame = None
+        if step_fn is None:
+            if cfg.telemetry:
+                tap: dict = {}
+                st = ring_mod.step(cfg, st, plan, rnd, tap=tap)
+                frame = frame_from_tap(tap)
+            else:
+                st = ring_mod.step(cfg, st, plan, rnd)
+        elif cfg.telemetry:
+            st, frame = step_fn(st, plan, rnd)
+        else:
+            st = step_fn(st, plan, rnd)
+        t = st.step - 1
+        base_plan = faults.base_of(plan)
+        up = ~(t >= base_plan.crash_step) & (t >= base_plan.join_step)
+        knowers = ring_mod.live_knower_counts(cfg, st, up)
+        gone = st.gone_key
+        gone_not_alive = lattice.is_suspect(gone) | lattice.is_dead(gone)
+        gone_dead = lattice.is_dead(gone)
+        not_alive, dead_seen, dead_all = _compact_subject_flags(
+            tr.subjects, st.subject, st.rkey, knowers, up,
+            gone_not_alive, gone_dead)
+        crashed = t >= tr.crash_step
+
+        def first(cur, cond):
+            hit = cond & crashed & (cur == NEVER)
+            return jnp.where(hit, t, cur)
+
+        tr = tr._replace(
+            first_suspect=first(tr.first_suspect, not_alive),
+            first_dead_view=first(tr.first_dead_view, dead_seen),
+            disseminated=first(tr.disseminated, dead_all),
+        )
+        counts = _view_counts(st.subject, st.rkey, knowers, up, gone_dead)
+        false_dead = _false_dead_views(st.subject, st.rkey, knowers, up,
+                                       gone_dead)
+        series = (
+            counts[0], counts[1], false_dead,
+            jnp.maximum(jnp.max(lattice.incarnation_of(st.rkey)),
+                        jnp.max(st.inc_self)).astype(jnp.int32),
+        )
+        return (st, tr), (series, frame)
+
+    (state, track), (series, frames) = jax.lax.scan(
+        body, (state, track), None, length=periods)
+    return state, track, PeriodSeries(*series), frames
+
+
+class StudyCheckpointer:
+    """Mid-study checkpoint/resume for the streaming driver.
+
+    A study checkpoint is {engine state, CompactTrack, series prefix,
+    root key, step}, written per-shard (utils/checkpoint.save_placed) so
+    a sharded 64M flagship never gathers its state to one host. Restore
+    re-places the engine state onto whatever sharding `state_like`
+    carries; the track and series prefix come back as host arrays (the
+    next chunk's jit re-places them)."""
+
+    def __init__(self, directory: str, every: int = 0, keep: int = 3):
+        self.directory = directory
+        self.every = every
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _snaps(self) -> list[str]:
+        return sorted(f for f in os.listdir(self.directory)
+                      if f.startswith("study_") and f.endswith(".npz"))
+
+    def save(self, state, track: CompactTrack, series: "PeriodSeries",
+             root_key: jax.Array, step: int) -> str:
+        path = os.path.join(self.directory, f"study_{step:012d}.npz")
+        checkpoint.save_placed(path, (state, track, series), root_key, step)
+        for f in self._snaps()[:-self.keep]:
+            os.remove(os.path.join(self.directory, f))
+        return path
+
+    def latest(self) -> str | None:
+        snaps = self._snaps()
+        return os.path.join(self.directory, snaps[-1]) if snaps else None
+
+    def restore(self, state_like):
+        """None when no snapshot exists; else (state, track, series
+        prefix, root_key, step). `state_like` supplies engine-state
+        structure and placement (e.g. a placed init_state)."""
+        path = self.latest()
+        if path is None:
+            return None
+        track_like = CompactTrack(None, None, None, None, None)
+        series_like = PeriodSeries(None, None, None, None)
+        (state, track, series), root_key, step = checkpoint.restore_placed(
+            path, (state_like, track_like, series_like))
+        return state, CompactTrack(*track), PeriodSeries(*series), \
+            root_key, step
+
+
+def run_study_ring_stream(cfg: SwimConfig, state, plan: FaultPlan,
+                          root_key: jax.Array, periods: int, step_fn=None,
+                          chunk: int = 0,
+                          ckpt: StudyCheckpointer | None = None
+                          ) -> RingStudyResult:
+    """Streaming ring study: O(crashes) milestone track, donated chunked
+    scan, optional mid-study checkpointing. Returns a RingStudyResult
+    whose `track` is a CompactTrack — detection_summary/study_milestones
+    understand both shapes, and milestones/series are bitwise-identical
+    to run_study_ring's (restricted to crashed subjects).
+
+    `chunk` periods per jitted call (0 = one chunk, or ckpt.every when
+    checkpointing). When `ckpt` holds a snapshot the study resumes from
+    it — callers pass the same (cfg, plan, root_key, periods) and the
+    resumed trajectory is bitwise-identical to an uninterrupted run."""
+    if ckpt is not None and cfg.telemetry:
+        raise ValueError("streaming study checkpointing does not cover "
+                         "telemetry frames; disable one of them")
+    track = None
+    done = 0
+    series_parts: list = []
+    frame_parts: list = []
+    if ckpt is not None:
+        restored = ckpt.restore(state)
+        if restored is not None:
+            state, track, series_prefix, root_key, done = restored
+            if done > periods:
+                raise ValueError(
+                    f"checkpoint at step {done} is beyond the requested "
+                    f"{periods}-period study")
+            series_parts.append(series_prefix)
+    if track is None:
+        track = compact_track_init(plan, periods)
+    else:
+        # a snapshot's subject list is a function of (plan, periods) at
+        # save time — resuming under a different pair would silently
+        # drop (or invent) crashed subjects, so refuse loudly
+        want = compact_track_init(plan, periods)
+        if not np.array_equal(np.asarray(want.subjects),
+                              np.asarray(track.subjects)):
+            raise ValueError(
+                "checkpointed subject list does not match this "
+                "(plan, periods); resume a study with its original "
+                "arguments")
+    if chunk <= 0:
+        chunk = (ckpt.every if ckpt is not None and ckpt.every > 0
+                 else periods)
+    while done < periods:
+        csize = min(chunk, periods - done)
+        state, track, series_c, frames_c = _run_study_ring_chunk(
+            cfg, state, track, plan, root_key, csize, step_fn)
+        done += csize
+        series_parts.append(jax.tree.map(np.asarray, series_c))
+        if frames_c is not None:
+            frame_parts.append(frames_c)
+        if ckpt is not None and done < periods:
+            series_so_far = PeriodSeries(*(np.concatenate(xs) for xs in
+                                           zip(*series_parts)))
+            ckpt.save(state, track, series_so_far, root_key, done)
+    series = PeriodSeries(*(jnp.asarray(np.concatenate(xs))
+                            for xs in zip(*series_parts)))
+    frames = None
+    if frame_parts:
+        frames = jax.tree.map(lambda *xs: jnp.concatenate(xs), *frame_parts)
+    return RingStudyResult(state, track, series, frames)
+
+
+# ---------------------------------------------------------------------------
 # Batched studies: one device step advances P scenarios (sim/faults.py
 # ProgramBatch).  jax.vmap over the raw study bodies gives every output a
 # leading [P] axis — states [P, ...], track [P, N], series [P, T], telemetry
@@ -405,7 +687,18 @@ def study_milestones(result: StudyResult, plan: FaultPlan,
     """(crash steps, milestone arrays) restricted to CRASHED subjects —
     the detection-summary inputs, in the shape the flight-recorder dump
     header embeds (obs/analyze.py recomputes the summary from these
-    offline; milestone keys name the summary's output prefixes)."""
+    offline; milestone keys name the summary's output prefixes).
+
+    A streaming study's CompactTrack already IS this restriction (same
+    ascending-subject order), so it passes through without a gather."""
+    if isinstance(result.track, CompactTrack):
+        milestones = {
+            name: np.asarray(arr).astype(np.int64)
+            for name, arr in (("suspect", result.track.first_suspect),
+                              ("dead_view", result.track.first_dead_view),
+                              ("disseminated", result.track.disseminated))}
+        return np.asarray(result.track.crash_step).astype(np.int64), \
+            milestones
     crash = np.asarray(faults.base_of(plan).crash_step)
     crashed = crash < periods
     milestones = {

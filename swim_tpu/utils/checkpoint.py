@@ -10,6 +10,7 @@ have taken. Stored as a single .npz (portable, no framework lock-in);
 from __future__ import annotations
 
 import os
+import re
 from typing import Any
 
 import jax
@@ -58,6 +59,137 @@ def jnp_like(arr: np.ndarray, like) -> jax.Array:
         raise ValueError(f"shape mismatch: {out.shape} vs {like.shape} "
                          "(checkpoint from a different config?)")
     return out
+
+
+# ---------------------------------------------------------------------------
+# Per-shard checkpoints for placed (sharded) state.
+#
+# `save`/`restore` above gather every leaf to one host buffer — fine for a
+# single-chip engine, but a sharded 64M-node ring state is tens of GB global
+# while each chip holds only its block. `save_placed` stores one block per
+# DISTINCT shard (replicated leaves dedup to a single copy) together with its
+# global index range; `restore_placed` re-places block-by-block via
+# `jax.make_array_from_single_device_arrays` when the target sharding matches
+# the saved layout, and falls back to assemble-then-device_put otherwise, so
+# checkpoints survive a mesh-shape change at the cost of one host gather.
+# ---------------------------------------------------------------------------
+
+
+def _part_ranges(idx: tuple, shape: tuple) -> np.ndarray:
+    """[ndim, 2] start/stop rows for one shard's global index slices."""
+    return np.asarray(
+        [[s.start or 0, s.stop if s.stop is not None else dim]
+         for s, dim in zip(idx, shape)], np.int64).reshape(len(shape), 2)
+
+
+def _placed_parts(x: Any) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Distinct (index-range, block) pairs of one leaf — one block per
+    distinct shard, iterated over devices sorted by id so the part order
+    is deterministic; replicated copies dedup to one part."""
+    if not isinstance(x, jax.Array) or len(x.devices()) == 1:
+        arr = np.asarray(x)
+        full = np.asarray([[0, d] for d in arr.shape],
+                          np.int64).reshape(arr.ndim, 2)
+        return [(full, arr)]
+    imap = x.sharding.addressable_devices_indices_map(x.shape)
+    by_dev = {s.device: s for s in x.addressable_shards}
+    parts: list = []
+    seen: set = set()
+    for dev in sorted(imap, key=lambda d: d.id):
+        rng = _part_ranges(imap[dev], x.shape)
+        key = rng.tobytes()
+        if key in seen:
+            continue
+        seen.add(key)
+        parts.append((rng, np.asarray(by_dev[dev].data)))
+    return parts
+
+
+def save_placed(path: str, tree: Any, root_key: jax.Array, step: int) -> None:
+    """Per-shard checkpoint of an arbitrarily placed pytree (see module
+    note). Works for single-device leaves and plain numpy leaves too —
+    they store as one full-range part."""
+    payload: dict[str, np.ndarray] = {
+        "__key_data": np.asarray(jax.random.key_data(root_key)),
+        "__step": np.asarray(step, np.int64),
+    }
+    leaves, _ = jax.tree.flatten(tree)
+    for i, x in enumerate(leaves):
+        for j, (rng, block) in enumerate(_placed_parts(x)):
+            payload[f"leaf_{i}_idx_{j}"] = rng
+            payload[f"leaf_{i}_part_{j}"] = block
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)  # atomic: a crash never leaves a torn checkpoint
+
+
+def _assemble(parts: list[tuple[np.ndarray, np.ndarray]]) -> np.ndarray:
+    """Stitch blocks back into one host array by their index ranges."""
+    shape = tuple(int(m) for m in
+                  np.max(np.stack([r[:, 1] for r, _ in parts]), axis=0)) \
+        if parts[0][0].size else ()
+    out = np.empty(shape, parts[0][1].dtype)
+    for rng, block in parts:
+        sl = tuple(slice(int(a), int(b)) for a, b in rng)
+        out[sl] = block
+    return out
+
+
+def _replace_leaf(parts: list, like: Any) -> Any:
+    """One restored leaf: re-placed per-shard when `like` is a placed
+    jax.Array whose layout matches the saved blocks; assembled on host
+    otherwise. A None `like` means 'any shape' (host array back)."""
+    if like is None:
+        return _assemble(parts)
+    if isinstance(like, jax.Array) and len(like.devices()) > 1:
+        if parts[0][1].dtype != like.dtype:
+            raise ValueError(f"dtype mismatch: {parts[0][1].dtype} vs "
+                             f"{like.dtype}")
+        imap = like.sharding.addressable_devices_indices_map(like.shape)
+        saved = {rng.tobytes(): block for rng, block in parts}
+        devs = sorted(imap, key=lambda d: d.id)
+        want = [_part_ranges(imap[d], like.shape) for d in devs]
+        if all(w.tobytes() in saved for w in want):
+            arrays = [jax.device_put(saved[w.tobytes()], d)
+                      for w, d in zip(want, devs)]
+            return jax.make_array_from_single_device_arrays(
+                like.shape, like.sharding, arrays)
+        full = _assemble(parts)
+        if tuple(full.shape) != tuple(like.shape):
+            raise ValueError(f"shape mismatch: {full.shape} vs {like.shape} "
+                             "(checkpoint from a different config?)")
+        return jax.device_put(full, like.sharding)
+    return jnp_like(_assemble(parts), like)
+
+
+def restore_placed(path: str, like: Any) -> tuple[Any, jax.Array, int]:
+    """Returns (tree, root_key, step). `like` supplies structure AND
+    placement: a leaf that is a placed jax.Array is restored shard-by-
+    shard onto the same devices; a None leaf returns the assembled host
+    array (for leaves whose shape the caller cannot know up front, e.g.
+    a variable-length series prefix)."""
+    leaves_like, treedef = jax.tree.flatten(like,
+                                            is_leaf=lambda v: v is None)
+    with np.load(path) as z:
+        nparts: dict[int, int] = {}
+        for k in z.files:
+            m = re.fullmatch(r"leaf_(\d+)_part_(\d+)", k)
+            if m:
+                i = int(m.group(1))
+                nparts[i] = max(nparts.get(i, 0), int(m.group(2)) + 1)
+        if len(nparts) != len(leaves_like):
+            raise ValueError(
+                "checkpoint layout does not match the provided state "
+                "structure (different config or engine?)")
+        out = []
+        for i, lk in enumerate(leaves_like):
+            parts = [(z[f"leaf_{i}_idx_{j}"], z[f"leaf_{i}_part_{j}"])
+                     for j in range(nparts[i])]
+            out.append(_replace_leaf(parts, lk))
+        root_key = jax.random.wrap_key_data(z["__key_data"])
+        step = int(z["__step"])
+    return jax.tree.unflatten(treedef, out), root_key, step
 
 
 class CheckpointManager:

@@ -1,6 +1,7 @@
 """Study runner metrics + checkpoint/resume determinism."""
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -118,3 +119,131 @@ def test_checkpoint_structure_mismatch_rejected(tmp_path):
     import pytest
     with pytest.raises(ValueError):
         checkpoint.restore(path, dense.init_state(cfg16))
+
+
+# -------------------------------------------------------------------------
+# Sharded study checkpoint/resume: the per-shard save/restore path
+# (utils/checkpoint.save_placed) under the streaming study driver, across
+# the full 2x2 ICI-wire matrix the flagship can ship with.  Small ring
+# geometry (test_ring_shard.py's) keeps each wire's step compile cheap.
+# -------------------------------------------------------------------------
+
+_SHARD_GEOM = dict(suspicion_mult=1.0, k_indirect=1, max_piggyback=2,
+                   ring_window_periods=2, ring_view_c=2)
+
+
+class _Preempted(RuntimeError):
+    pass
+
+
+class _DyingCheckpointer(runner.StudyCheckpointer):
+    """Simulates preemption: the run dies right after its first
+    snapshot lands — the study's own arguments (periods included) never
+    change, exactly like a killed flagship run."""
+
+    def save(self, *a, **kw):
+        path = super().save(*a, **kw)
+        raise _Preempted(path)
+
+
+def _placed_study(cfg, plan0, key, periods, ckpt=None, chunk=0):
+    from swim_tpu.models import ring
+    from swim_tpu.parallel import mesh as pmesh
+    from swim_tpu.parallel import ring_shard
+
+    mesh = pmesh.make_mesh()
+    state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg), plan0)
+    step = ring_shard.mapped_step(cfg, mesh)
+    return runner.run_study_ring_stream(cfg, state, plan, key, periods,
+                                        step, ckpt=ckpt,
+                                        chunk=chunk), plan
+
+
+# the flagship's throughput configuration: the compact ICI wire and the
+# packed scalar wire both require the period-scope rotor path
+_FLAGSHIP_WIRES = dict(ring_sel_scope="period", ring_ici_wire="compact",
+                       ring_scalar_wire="packed")
+
+_PLAN_CRASHES = ([5, 23, 41], [2, 3, 5])
+
+
+def _resume_roundtrip(cfg, tmp_path, tag):
+    """Preempt at the first snapshot, resume, compare bitwise.  The
+    reference run uses the same chunk length as the checkpointed runs so
+    all three share ONE compiled chunk program (chunking is already
+    pinned invisible in tests/test_memwall.py)."""
+    n, p, every = 64, 8, 4
+    key = jax.random.key(11)
+    plan0 = faults.with_crashes(faults.none(n), *_PLAN_CRASHES)
+    ref, plan = _placed_study(cfg, plan0, key, p, chunk=every)
+    ck_dir = str(tmp_path / tag)
+    with pytest.raises(_Preempted):
+        _placed_study(cfg, plan0, key, p,
+                      ckpt=_DyingCheckpointer(ck_dir, every=every))
+    ck = runner.StudyCheckpointer(ck_dir, every=every)
+    assert ck.latest().endswith("study_000000000004.npz")
+    res, _ = _placed_study(cfg, plan0, key, p, ckpt=ck)
+    cr_r, m_r = runner.study_milestones(ref, plan, p)
+    cr_c, m_c = runner.study_milestones(res, plan, p)
+    np.testing.assert_array_equal(cr_r, cr_c)
+    for k in m_r:
+        np.testing.assert_array_equal(m_r[k], m_c[k], err_msg=tag)
+    for a, b in zip(jax.tree.leaves(ref.series),
+                    jax.tree.leaves(res.series)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(ref.state),
+                    jax.tree.leaves(res.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_ring_shard_stream_checkpoint_resume_flagship(tmp_path):
+    """Mid-study per-shard save -> preemption -> restore -> the resumed
+    trajectory is bitwise-identical to the uninterrupted one, on the
+    flagship wire configuration (compact ICI x packed scalar)."""
+    cfg = SwimConfig(n_nodes=64, **_FLAGSHIP_WIRES, **_SHARD_GEOM)
+    _resume_roundtrip(cfg, tmp_path, "compact_packed")
+
+
+@pytest.mark.slow  # one shard_map compile per wire combo; the tier-1
+# budget covers the flagship combo above, the full 2x2 matrix depth
+# runs via scripts/run_suite.py
+def test_ring_shard_stream_checkpoint_resume_matrix(tmp_path):
+    """The resume round-trip across the remaining window/compact ICI
+    wire x wide/packed scalar wire combos (all on the period-scope
+    rotor path, which the compact and packed wires require)."""
+    for ici in ("window", "compact"):
+        for scalar in ("wide", "packed"):
+            if (ici, scalar) == ("compact", "packed"):
+                continue  # the fast flagship test above
+            cfg = SwimConfig(n_nodes=64, ring_sel_scope="period",
+                             ring_ici_wire=ici, ring_scalar_wire=scalar,
+                             **_SHARD_GEOM)
+            _resume_roundtrip(cfg, tmp_path, f"{ici}_{scalar}")
+
+
+def test_ring_shard_stream_restore_preserves_sharding(tmp_path):
+    """restore() re-places the engine state on the structure template's
+    sharding — every restored leaf matches its placed twin's sharding.
+    Same config/plan/chunk as the flagship round-trip so this shares its
+    compiled chunk program."""
+    from swim_tpu.models import ring
+    from swim_tpu.parallel import mesh as pmesh
+    from swim_tpu.parallel import ring_shard
+
+    n, p = 64, 8
+    cfg = SwimConfig(n_nodes=n, **_FLAGSHIP_WIRES, **_SHARD_GEOM)
+    plan0 = faults.with_crashes(faults.none(n), *_PLAN_CRASHES)
+    mesh = pmesh.make_mesh()
+    state, plan = ring_shard.place(cfg, mesh, ring.init_state(cfg), plan0)
+    step = ring_shard.mapped_step(cfg, mesh)
+    ck = runner.StudyCheckpointer(str(tmp_path), every=4)
+    runner.run_study_ring_stream(cfg, state, plan, jax.random.key(11), p,
+                                 step, ckpt=ck)
+    like, _ = ring_shard.place(cfg, mesh, ring.init_state(cfg), plan0)
+    restored = ck.restore(like)
+    assert restored is not None
+    r_state, _, _, _, step_no = restored
+    assert step_no == 4
+    for got, want in zip(jax.tree.leaves(r_state), jax.tree.leaves(like)):
+        assert got.sharding.is_equivalent_to(want.sharding, got.ndim)
+        assert got.shape == want.shape and got.dtype == want.dtype

@@ -824,6 +824,47 @@ def bench_memwall(n_nodes: int, periods: int) -> dict:
     }
 
 
+def bench_audit(n_nodes: int, periods: int) -> dict:
+    """Static contract-audit tier (analysis/audit.py): every compiled-
+    program contract — retrace budget, donation coverage, wire payloads,
+    ICI tally completeness, barrier survival, hot-path hygiene — checked
+    deviceless against the jaxpr and AOT HLO.
+
+    The headline value is the VIOLATION byte total (unattributed
+    collective bytes + undonated bytes); a healthy tree reports 0, and
+    the `audit_peak_bytes` trend series inverts like the memwall gate —
+    a rise is the regression.  `ok_parity` carries the unwaived-failure
+    verdict so a red contract fails the tier outright."""
+    from swim_tpu.utils.platform import ensure_virtual_devices
+
+    ensure_virtual_devices(8)  # no-op when a count (or real TPUs) exist
+    from swim_tpu.analysis import audit
+
+    wire_n = n_nodes or 512
+    report = audit.run_audit(wire_n=wire_n, periods=periods or 4)
+    ok, failures = audit.check_report(report)
+    totals = report["totals"]
+    return {
+        "nodes": wire_n, "retrace_n": report["retrace_n"],
+        "periods": report["periods"],
+        "contracts": {name: report["contracts"][name]["status"]
+                      for name in sorted(report["contracts"])},
+        "checks_total": totals["checks_total"],
+        "failures": totals["failures"],
+        "waived": totals["waived"],
+        "retraces_extra": totals["retraces_extra"],
+        "unattributed_collective_bytes":
+            totals["unattributed_collective_bytes"],
+        "undonated_bytes": totals["undonated_bytes"],
+        "barrier_chains_missing": totals["barrier_chains_missing"],
+        "failed_checks": failures,
+        "violation_bytes": (totals["unattributed_collective_bytes"]
+                            + totals["undonated_bytes"]),
+        "report": report,
+        "ok_parity": ok,
+    }
+
+
 TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
             "ringp": functools.partial(bench_ring,
@@ -860,7 +901,7 @@ def run_tier_child(args) -> int:
         jax.config.update("jax_platforms", args.platform)
     # else ("default"/"auto"): leave the ambient platform alone.
     if args._tier in ("telemetry", "profiler", "scenariobatch",
-                      "memwall"):
+                      "memwall", "audit"):
         # Artifact tiers share one shape: run a self-contained contract
         # measurement (on/off overhead at the lean anchor, the
         # batched-vs-serial scenario fleet, or the AOT memory-wall
@@ -868,9 +909,11 @@ def run_tier_child(args) -> int:
         fn = {"telemetry": bench_telemetry_overhead,
               "profiler": bench_profiler_overhead,
               "scenariobatch": bench_scenario_batch,
-              "memwall": bench_memwall}[args._tier]
+              "memwall": bench_memwall,
+              "audit": bench_audit}[args._tier]
         artifact = {"scenariobatch": "scenariobatch_fleet.json",
-                    "memwall": "memwall_report.json"}.get(
+                    "memwall": "memwall_report.json",
+                    "audit": "audit_bench.json"}.get(
                         args._tier, f"{args._tier}_overhead.json")
         try:
             import jax
@@ -878,14 +921,19 @@ def run_tier_child(args) -> int:
             res = fn(args.nodes, args.periods)
             ok = bool(res.pop("ok_parity", True))
             if not ok:
-                res["error"] = (
-                    "streaming study diverged from the stacked path "
-                    "(milestone/series/state parity or donation wiring) "
-                    "— the compiled-shape rows are not publishable"
-                    if args._tier == "memwall" else
-                    "batched fleet diverged from serial "
-                    "(lane bitwise or verdict parity) — "
-                    "throughput not publishable")
+                res["error"] = {
+                    "memwall":
+                        "streaming study diverged from the stacked path "
+                        "(milestone/series/state parity or donation "
+                        "wiring) — the compiled-shape rows are not "
+                        "publishable",
+                    "audit":
+                        "unwaived contract failure(s): "
+                        + "; ".join(res.get("failed_checks", []))[:300],
+                }.get(args._tier,
+                      "batched fleet diverged from serial "
+                      "(lane bitwise or verdict parity) — "
+                      "throughput not publishable")
             res.update(ok=ok, tier=args._tier,
                        platform_actual=jax.devices()[0].platform)
             path = os.path.join(
@@ -998,7 +1046,8 @@ def main() -> int:
                     choices=("dense", "rumor", "shard", "ring", "ringp",
                              "ringpull", "ringshard", "ringshardc",
                              "telemetry", "profiler", "scenariobatch",
-                             "memwall", "flagship", "both", "all"))
+                             "memwall", "audit", "flagship", "both",
+                             "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
     ap.add_argument("--platform", default="auto",
@@ -1082,6 +1131,11 @@ def main() -> int:
             # skips the minutes-long deviceless TPU compiles)
             nodes = args.nodes or (4096 if args.smoke else 65_536)
             p = args.periods or 12
+        if tier == "audit":
+            # contract audit sizes its own arms; nodes picks the 2x2
+            # wire-matrix N (compile-bound: smoke shrinks it)
+            nodes = args.nodes or (256 if args.smoke else 512)
+            p = args.periods or 4
         if tier in ("rumor", "shard") and nodes >= 262_144 \
                 and not args.periods:
             # The scatter-delivery engines serialize their updates on
@@ -1155,6 +1209,36 @@ def main() -> int:
                    "platform": platform, "error": r.get("error")}
             out.update({k: v for k, v in r.items()
                         if k not in ("ok", "error")})
+        out.update(info)
+        print(json.dumps(out))
+        return 0
+
+    if args.tier == "audit":
+        # Contract-audit tier: the headline is the violation byte total
+        # (unattributed collective bytes + undonated bytes — 0 on a
+        # healthy tree).  The audit_peak_bytes / audit_nodes pair
+        # auto-registers with obs/trend.py, whose gate INVERTS for the
+        # bytes family — any rise above the zero baseline is gated like
+        # a throughput drop.
+        r = results.get(args.tier, {})
+        if r.get("ok"):
+            out = {"metric": (f"contract violation bytes @ "
+                              f"{r['nodes']} wire nodes "
+                              f"({r['checks_total']} checks, "
+                              f"{r['waived']} waived, {platform})"),
+                   "value": r["violation_bytes"], "unit": "bytes",
+                   "platform": platform,
+                   "audit_nodes": r["nodes"],
+                   "audit_peak_bytes": r["violation_bytes"]}
+            out.update({k: v for k, v in r.items()
+                        if k not in ("ok", "report")})
+        else:
+            out = {"metric": (f"contract violation bytes (tier failed, "
+                              f"{platform})"),
+                   "value": -1.0, "unit": "bytes",
+                   "platform": platform, "error": r.get("error")}
+            out.update({k: v for k, v in r.items()
+                        if k not in ("ok", "error", "report")})
         out.update(info)
         print(json.dumps(out))
         return 0

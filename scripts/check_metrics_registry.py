@@ -190,6 +190,107 @@ def check_mem_gauges() -> list[str]:
     return problems
 
 
+def check_audit_gauges() -> list[str]:
+    """Problems with the swim_audit_* gauge surface ([] = clean).
+
+    Mirrors check_mem_gauges: (a) the literal `swim_audit_*` keys in
+    analysis/audit.py gauge_values (AST source scan) must be exactly
+    audit.AUDIT_GAUGES; (b) render_audit over a synthetic report must
+    emit exactly the AUDIT_GAUGES series; (c) every name must be a
+    legal Prometheus metric name.  Plus the contract-table pairing:
+    each CONTRACTS family and each WAIVERS entry must reference a
+    declared contract, so a renamed contract can never orphan a waiver.
+    """
+    import re
+
+    from swim_tpu.analysis.audit import AUDIT_GAUGES, CONTRACTS, WAIVERS
+    from swim_tpu.obs.expo import render_audit
+
+    problems: list[str] = []
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for name in AUDIT_GAUGES:
+        if not name_re.match(name):
+            problems.append(f"AUDIT_GAUGES entry {name!r} is not a legal "
+                            "Prometheus metric name")
+    audit_py = os.path.join(os.path.dirname(NODE_PY), os.pardir,
+                            "analysis", "audit.py")
+    with open(audit_py) as f:
+        tree = ast.parse(f.read(), filename=audit_py)
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "gauge_values"), None)
+    if fn is None:
+        problems.append("analysis/audit.py has no gauge_values()")
+    else:
+        written = {n.value for n in ast.walk(fn)
+                   if isinstance(n, ast.Constant)
+                   and isinstance(n.value, str)
+                   and n.value.startswith("swim_audit_")}
+        if written != set(AUDIT_GAUGES):
+            problems.append(
+                f"audit.gauge_values writes {sorted(written)} but "
+                f"AUDIT_GAUGES declares {sorted(AUDIT_GAUGES)} — keep "
+                "the two in lockstep")
+    fake = {"wire_n": 1, "retrace_n": 1, "platform": "cpu",
+            "totals": {"checks_total": 0, "failures": 0, "waived": 0,
+                       "retraces_extra": 0,
+                       "unattributed_collective_bytes": 0,
+                       "undonated_bytes": 0,
+                       "barrier_chains_missing": 0}}
+    emitted = {line.split("{")[0].split(" ")[0]
+               for line in render_audit(fake).splitlines()
+               if line and not line.startswith("#")}
+    if emitted != set(AUDIT_GAUGES):
+        problems.append(
+            f"render_audit emits {sorted(emitted)} but AUDIT_GAUGES "
+            f"declares {sorted(AUDIT_GAUGES)} — keep the renderer and "
+            "the gauge table in lockstep")
+    for waiver in WAIVERS:
+        if waiver.get("contract") not in CONTRACTS:
+            problems.append(
+                f"audit waiver names unknown contract "
+                f"{waiver.get('contract')!r} — waivers must reference "
+                "CONTRACTS entries")
+        if not waiver.get("pointer"):
+            problems.append(
+                f"audit waiver for {waiver.get('contract')!r}/"
+                f"{waiver.get('arm')!r} has no tracking pointer — a "
+                "waiver is a debt, not a hole")
+    return problems
+
+
+def check_ici_terms() -> list[str]:
+    """Problems with the auditor's ICI tally vocabulary ([] = clean).
+
+    The tally-completeness contract attributes traced collective bytes
+    to the named terms in audit.ICI_TERM_FAMILIES; a term the tally no
+    longer emits (rename, removal) would silently leave its family's
+    budget over-claimed.  Terms are declared where the bytes move: the
+    psum/gather terms as literal keys in obs/ici.py, the roll_* terms
+    as `label=` literals at the models/ring.py (and sharded-ops) call
+    sites.  Require every auditor term to appear as a QUOTED literal in
+    at least one of those sources — the reverse direction (no breakdown
+    key outside the auditor's vocabulary) is checked at trace time by
+    the contract itself.
+    """
+    from swim_tpu.analysis.audit import ICI_TERMS
+
+    pkg = os.path.dirname(os.path.dirname(NODE_PY))
+    sources = ""
+    for rel in (("obs", "ici.py"), ("models", "ring.py"),
+                ("parallel", "ring_shard.py")):
+        with open(os.path.join(pkg, *rel)) as f:
+            sources += f.read()
+    problems: list[str] = []
+    for term in ICI_TERMS:
+        if f'"{term}"' not in sources and f"'{term}'" not in sources:
+            problems.append(
+                f"auditor tally term {term!r} is not a declared key in "
+                "obs/ici.py or a roll label in models/ring.py — update "
+                "audit.ICI_TERM_FAMILIES to match the tally vocabulary")
+    return problems
+
+
 def check_scenario_rules() -> list[str]:
     """Problems with the scenario/health-rule surface ([] = clean).
 
@@ -339,6 +440,12 @@ def main() -> int:
     for problem in check_mem_gauges():
         ok = False
         print(f"mem-gauge lint: {problem}", file=sys.stderr)
+    for problem in check_audit_gauges():
+        ok = False
+        print(f"audit-gauge lint: {problem}", file=sys.stderr)
+    for problem in check_ici_terms():
+        ok = False
+        print(f"ici-term lint: {problem}", file=sys.stderr)
     scenario_problems = check_scenario_rules()
     for problem in scenario_problems:
         ok = False
@@ -349,6 +456,7 @@ def main() -> int:
     for problem in check_trend_tier_keys():
         ok = False
         print(f"trend-key lint: {problem}", file=sys.stderr)
+    from swim_tpu.analysis.audit import AUDIT_GAUGES, ICI_TERMS
     from swim_tpu.obs.health import HEALTH_RULES
     from swim_tpu.obs.memwall import MEM_GAUGES
     from swim_tpu.obs.prof import PROF_GAUGES
@@ -358,7 +466,9 @@ def main() -> int:
           f"{len(NODE_COUNTERS)} declared counters, "
           f"{len(HEALTH_RULES)} health gauges, "
           f"{len(PROF_GAUGES)} profiler gauges, "
-          f"{len(MEM_GAUGES)} memory gauges and "
+          f"{len(MEM_GAUGES)} memory gauges, "
+          f"{len(AUDIT_GAUGES)} audit gauges, "
+          f"{len(ICI_TERMS)} tally terms and "
           f"{len(LIBRARY)} library scenarios: "
           f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1

@@ -39,6 +39,12 @@ whenever --artifacts-dir is given; force with --scenarios on/off.
 Scenario outputs land in <artifacts-dir>/scenarios, deliberately
 OUTSIDE the raw top-level telemetry sweep: ungated contrast arms dump
 error findings on purpose, and the verdict is their gate-aware judge.
+
+The AUDIT gate (round 14): alongside the scenario gate, `swim-tpu
+audit --check` verifies the static compiled-program contracts
+(analysis/audit.py — retrace budget, donation coverage, wire payloads,
+ICI tally completeness, barrier survival, hot-path hygiene) at
+smoke-sized arms; an unwaived contract failure fails the run by name.
 """
 from __future__ import annotations
 
@@ -70,6 +76,7 @@ FAST_FILES = (
     "tests/test_bridge.py",
     "tests/test_graft_entry.py",
     "tests/test_sampling.py",
+    "tests/test_audit.py",
 )
 
 # Scenario gate: the library's sub-minute adversarial scenarios, run via
@@ -171,6 +178,43 @@ def collect_artifacts(dest: str) -> list[str]:
     return copied
 
 
+def run_audit_gate(timeout: float, env: dict) -> list[str]:
+    """Run `swim-tpu audit --check`; return failure labels ([] = green).
+
+    The static contract gate (analysis/audit.py): retrace budget,
+    donation coverage, wire payloads, tally completeness, barrier
+    survival, hygiene — deviceless, so it runs anywhere the suite runs.
+    Smoke-sized arms (the seeded-violation tests in test_audit.py cover
+    the detection logic; this gate proves the COMMITTED TREE satisfies
+    every contract end to end).  Report writing is skipped — the
+    committed bench_results/audit_report.json stays byte-stable, owned
+    by explicit `swim-tpu audit` runs."""
+    t0 = time.time()
+    p = subprocess.Popen(
+        [sys.executable, "-m", "swim_tpu.cli", "audit", "--check",
+         "--out", "", "--wire-n", "256", "--retrace-n", "128"],
+        cwd=REPO, env=env, text=True, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        out, _ = p.communicate(timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        out, rc = f"TIMEOUT after {timeout:.0f}s", None
+    dt = time.time() - t0
+    mark = "PASS" if rc == 0 else "FAIL"
+    print(f"{mark} audit:contracts                     {dt:7.1f}s",
+          flush=True)
+    if rc != 0:
+        for line in (out or "").strip().splitlines()[-10:]:
+            print(f"  {line}", flush=True)
+        return ["audit:contracts"]
+    return []
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("pattern", nargs="?", default="tests/test_*.py")
@@ -267,6 +311,7 @@ def main() -> int:
             args.artifacts_dir or os.path.join(REPO, "suite_scenarios"),
             "scenarios")
         failures += run_scenarios(scen_dir, args.timeout_per_file, env)
+        failures += run_audit_gate(args.timeout_per_file, env)
     if args.artifacts_dir:
         copied = collect_artifacts(args.artifacts_dir)
         print(f"artifacts -> {args.artifacts_dir} ({len(copied)}):")

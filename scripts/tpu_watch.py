@@ -227,6 +227,15 @@ CAPTURES: list = [
       "--crash-fraction", "0.00001", "--stream", "on",
       "--checkpoint-dir", "bench_results/ckpt_64m",
       "--checkpoint-every", "4"], 14400, False, None),
+    # Contract audit (analysis/audit.py): deviceless verification of
+    # the trace/donation/wire/tally/barrier/hygiene invariants at the
+    # default shapes.  The audit compiles AOT on the host CPU (the
+    # contracts are about program structure, not wall-clock), so the
+    # payload check gates on the contract verdict rather than the
+    # platform: every check must pass or be formally waived.
+    ("audit",
+     ["bench.py", "--tier", "audit", "--tier-timeout", "900"], 1200,
+     False, lambda p: bool(p.get("ok_parity"))),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

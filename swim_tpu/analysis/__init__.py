@@ -1,0 +1,9 @@
+"""Static analysis of the compiled engines (deviceless, no hardware).
+
+`audit` verifies the compiled-program contracts the performance claims
+rest on — retrace budget, donation coverage, wire payloads, ICI tally
+completeness, barrier-chain survival, hot-path hygiene — against the
+jaxpr and AOT-compiled HLO.  Import-time jax-free, like the obs stack.
+"""
+
+from swim_tpu.analysis import audit  # noqa: F401

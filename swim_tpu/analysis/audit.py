@@ -1,0 +1,765 @@
+"""Contract auditor: deviceless static verification of compiled-program laws.
+
+The repo's performance story rests on invariants of the COMPILED program,
+not just the protocol semantics the dynamic gates check: the S-axis
+zero-retrace promise of the scenario compiler, buffer donation in every
+study runner, the packed wire's u8-only collective-permute payloads, the
+named ICI byte tally of `obs/ici.py`, and the `optimization_barrier`
+ordering chains that break the one-chip memory wall.  Each of those was
+enforced (if at all) by a scattered ad-hoc pin.  This module gives them
+one machine-checked table.
+
+Methodology — no hardware in the loop, matching `obs/memwall.py`:
+
+* **jaxpr level** (trace only): collective byte accounting, barrier-chain
+  presence, retrace counting, dtype/callback hygiene.  Collective bytes
+  are counted with `lax.cond`/`lax.switch` branches contributing the MAX
+  over branches (exactly one executes) and `lax.scan` contributing
+  length x body.  This matters: a global roll by a traced shard distance
+  lowers to a switch whose D branches each hold a collective-permute, so
+  naive HLO text summation over-counts mutually-exclusive branches by D.
+* **HLO level** (AOT compile, CPU mesh or deviceless XLA:TPU): payload
+  dtype/shape pins via `scan_hlo_collectives` — per-line checks that are
+  robust to the branch duplication above — plus the no-replication-scale-
+  all-gather guarantee.
+* **artifact level**: the committed `bench_results/memwall_report.json`
+  carries the 64M sharded AOT row; the barrier-survival contract reads it
+  so the known GSPMD chain drop (ROADMAP item 2) is a named, waived check
+  instead of folklore.
+
+Everything here is import-time jax-free (the metrics-registry lint and
+`obs/expo.py` import this module without a backend); jax is imported
+inside `run_audit` only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# The contract table.  Names are load-bearing: tests assert failures fire
+# by name, the registry lint cross-checks gauges against this table, and
+# waivers reference (contract, arm) pairs.
+# ---------------------------------------------------------------------------
+
+CONTRACTS = {
+    "retrace_budget":
+        "one compile per (engine, static-config) arm across a fault-program "
+        "value sweep — S is the only trace axis",
+    "donation_coverage":
+        "every donate_argnums leaf is aliased in the compiled executable: "
+        "alias bytes == donated bytes, exactly, for every study runner",
+    "wire_contracts":
+        "packed arms ship u8 collective-permute payloads and no [S]-shaped "
+        "s32/pred lanes; no replication-scale all-gather; compact wire moves "
+        "strictly fewer ppermute bytes than the window wire",
+    "ici_tally_completeness":
+        "every traced collective byte is attributed to a named obs/ici.py "
+        "tally term — unattributed bytes fail",
+    "barrier_survival":
+        "the census-chunk and pull-gather optimization_barrier chains are "
+        "present as ordering edges in the traced program, and the sharded "
+        "GSPMD lowering keeps the census chain alive (64M AOT row)",
+    "hot_path_hygiene":
+        "no f64 values and no host callbacks inside traced engine steps "
+        "and study bodies",
+}
+
+# Expected-fail entries: a failing check whose (contract, arm) appears here
+# is reported as "waived" instead of failing the audit.  Each entry names
+# the tracking pointer so the waiver is a debt, not a hole.
+WAIVERS = (
+    {
+        "contract": "barrier_survival",
+        "arm": "sharded_gspmd_64m",
+        "reason":
+            "The census-chunk optimization_barrier chain does not survive "
+            "the GSPMD sharded lowering: the committed 64M ringshard AOT row "
+            "OOMs at ~733G HLO temp (dozens of ~921M cold-plane slices at "
+            "models/ring.py:595 held live, plus a 5G shmap-body window "
+            "select).  Fix: re-pin the chain under GSPMD or move the census "
+            "inside the shard body.",
+        "pointer": "ROADMAP.md item 2; models/ring.py:595",
+    },
+)
+
+# ---------------------------------------------------------------------------
+# ICI tally vocabulary: which obs/ici.py breakdown terms attribute which
+# collective family.  The registry lint verifies every term below appears
+# in obs/ici.py; the completeness contract verifies the reverse direction
+# (no breakdown key outside this vocabulary, no traced byte outside the
+# terms' budget).
+# ---------------------------------------------------------------------------
+
+ICI_TERM_FAMILIES = {
+    "ppermute": (
+        "roll_probe_gate", "roll_ok_waves", "roll_pid_waves",
+        "roll_link_thr", "roll_buddy_slots", "roll_buddy_cols",
+        "roll_buddy_vals", "roll_view_slots", "roll_view_known",
+        "roll_view_verdict", "roll_sel_waves", "sel_wire_boundary",
+    ),
+    "psum": ("psum_scalar", "gather_psum", "knows_psum"),
+    "all_gather": ("candidates_all_gather",),
+}
+
+ICI_TERMS = tuple(sorted(
+    t for fam in ICI_TERM_FAMILIES.values() for t in fam))
+
+# ---------------------------------------------------------------------------
+# HLO collective scanner (shared with tests/test_ring_shard.py).
+# ---------------------------------------------------------------------------
+
+DTYPE_BYTES = {
+    "pred": 1, "u8": 1, "s8": 1,
+    "u16": 2, "s16": 2, "f16": 2, "bf16": 2,
+    "u32": 4, "s32": 4, "f32": 4,
+    "u64": 8, "s64": 8, "f64": 8,
+}
+
+HLO_COLLECTIVE_OPS = ("collective-permute", "all-gather", "all-reduce",
+                      "all-to-all", "collective-broadcast")
+
+_HLO_COLL_RE = re.compile(
+    r"\b(" + "|".join(HLO_COLLECTIVE_OPS) + r")(-start|-done)?\(")
+_HLO_SHAPE_RE = re.compile(r"\b(\w+)\[([\d,]*)\]")
+
+
+def scan_hlo_collectives(hlo_text: str) -> list[dict]:
+    """Inventory of collective instructions in an HLO module text.
+
+    One record per instruction line: ``{"op", "payloads", "payload_bytes",
+    "line"}`` where ``payloads`` lists every typed shape on the line as
+    ``{"dtype", "elems", "bytes"}`` and ``payload_bytes`` is the largest
+    (a win-sized operand can't hide inside an async-start tuple).  ``-done``
+    halves of async pairs are skipped so each transfer counts once.
+
+    NOTE: counts are STATIC instruction counts — collectives inside the
+    branches of a `conditional` all appear even though one executes.  Use
+    per-line dtype/shape checks on these records (branch-duplication-proof)
+    and `jaxpr_collective_bytes` for executed-byte accounting.
+    """
+    records = []
+    for line in hlo_text.splitlines():
+        if "=" not in line:
+            continue
+        m = _HLO_COLL_RE.search(line)
+        if not m or m.group(2) == "-done":
+            continue
+        payloads = []
+        for sm in _HLO_SHAPE_RE.finditer(line):
+            dtype, dims = sm.group(1), sm.group(2)
+            if dtype not in DTYPE_BYTES:
+                continue
+            elems = 1
+            for part in dims.split(","):
+                if part:
+                    elems *= int(part)
+            payloads.append({"dtype": dtype, "elems": elems,
+                             "bytes": elems * DTYPE_BYTES[dtype]})
+        records.append({
+            "op": m.group(1),
+            "payloads": payloads,
+            "payload_bytes": max((p["bytes"] for p in payloads), default=0),
+            "line": line.strip()[:160],
+        })
+    return records
+
+
+def max_payload_elems(records: list[dict], op: str) -> int:
+    """Largest element count on any `op` instruction line (1 if none)."""
+    worst = 1
+    for r in records:
+        if r["op"] != op:
+            continue
+        for p in r["payloads"]:
+            worst = max(worst, p["elems"])
+    return worst
+
+
+def cperm_payloads(records: list[dict]) -> list[dict]:
+    """Flat payload list across all collective-permute instructions."""
+    return [p for r in records if r["op"] == "collective-permute"
+            for p in r["payloads"]]
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walkers.  These take jaxpr objects (so the caller has already
+# imported jax); the walkers themselves only touch .eqns/.params/.aval.
+# ---------------------------------------------------------------------------
+
+_JAXPR_COLLECTIVES = {
+    "ppermute": "ppermute",
+    "psum": "psum",
+    "psum_invariant": "psum",
+    "all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+}
+
+_FORBIDDEN_PRIMS = ("pure_callback", "io_callback", "debug_callback",
+                    "callback", "host_callback_call")
+
+
+def _param_jaxprs(eqn):
+    for v in eqn.params.values():
+        for s in (v if isinstance(v, (list, tuple)) else (v,)):
+            inner = getattr(s, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(s, "eqns"):
+                yield s
+
+
+def _aval_bytes(avals) -> int:
+    total = 0
+    for a in avals:
+        shape = getattr(a, "shape", None)
+        dtype = getattr(a, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        elems = 1
+        for dim in shape:
+            elems *= int(dim)
+        total += elems * dtype.itemsize
+    return total
+
+
+def jaxpr_collective_bytes(jaxpr) -> dict[str, int]:
+    """Executed collective payload bytes per family, from the trace.
+
+    `cond`/`switch` contributes the max over branches (exactly one runs);
+    `scan` contributes length x body; a `while` whose body holds
+    collectives is unbounded statically and is surfaced under the
+    ``"while_unbounded"`` key so the contract fails loud instead of
+    under-counting.  all_gather counts output bytes (what lands per
+    chip); everything else counts input payload bytes.
+    """
+    out: dict[str, int] = {}
+
+    def merge(dst, src, mult=1):
+        for k, v in src.items():
+            dst[k] = dst.get(k, 0) + mult * v
+
+    def walk(j, acc):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in _JAXPR_COLLECTIVES:
+                family = _JAXPR_COLLECTIVES[name]
+                avals = [v.aval for v in
+                         (eqn.outvars if name == "all_gather"
+                          else eqn.invars)]
+                acc[family] = acc.get(family, 0) + _aval_bytes(avals)
+            elif name == "cond":
+                best: dict[str, int] = {}
+                for branch in eqn.params["branches"]:
+                    sub: dict[str, int] = {}
+                    walk(branch.jaxpr, sub)
+                    if sum(sub.values()) > sum(best.values()):
+                        best = sub
+                merge(acc, best)
+            elif name == "scan":
+                sub = {}
+                walk(eqn.params["jaxpr"].jaxpr, sub)
+                merge(acc, sub, mult=int(eqn.params.get("length", 1)))
+            elif name == "while":
+                sub = {}
+                walk(eqn.params["body_jaxpr"].jaxpr, sub)
+                if sub:
+                    acc["while_unbounded"] = (
+                        acc.get("while_unbounded", 0) + sum(sub.values()))
+            else:
+                for inner in _param_jaxprs(eqn):
+                    walk(inner, acc)
+
+    walk(jaxpr, out)
+    return out
+
+
+def jaxpr_count_primitive(jaxpr, prim_name: str) -> int:
+    """Static count of `prim_name` equations, all sub-jaxprs included."""
+    count = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == prim_name:
+            count += 1
+        for inner in _param_jaxprs(eqn):
+            count += jaxpr_count_primitive(inner, prim_name)
+    return count
+
+
+def jaxpr_hygiene_violations(jaxpr) -> list[str]:
+    """Sorted, deduplicated f64/callback violations in a traced program."""
+    found: set[str] = set()
+
+    def walk(j):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in _FORBIDDEN_PRIMS:
+                found.add(f"callback:{name}")
+            for var in (*eqn.invars, *eqn.outvars):
+                dtype = getattr(getattr(var, "aval", None), "dtype", None)
+                if dtype is not None and str(dtype) == "float64":
+                    found.add(f"f64:{name}")
+            for inner in _param_jaxprs(eqn):
+                walk(inner)
+
+    walk(jaxpr)
+    return sorted(found)
+
+
+def tally_unattributed(family_bytes: dict[str, int],
+                       breakdown: dict[str, int]) -> dict[str, int]:
+    """Per-family bytes the trace moves but no named tally term claims.
+
+    Returns ``{family: max(0, traced - attributed)}`` plus an
+    ``"unknown_term:<key>"`` entry for any breakdown key outside
+    ICI_TERM_FAMILIES (vocabulary drift fails too) and the pass-through
+    of any ``while_unbounded`` traced bytes.
+    """
+    out: dict[str, int] = {}
+    known = set(ICI_TERMS)
+    for key in breakdown:
+        if key not in known:
+            out[f"unknown_term:{key}"] = int(breakdown[key])
+    for family, traced in sorted(family_bytes.items()):
+        if family == "while_unbounded":
+            out[family] = int(traced)
+            continue
+        terms = ICI_TERM_FAMILIES.get(family, ())
+        attributed = sum(int(breakdown.get(t, 0)) for t in terms)
+        out[family] = max(0, int(traced) - attributed)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Audit arms.  Geometry mirrors tests/test_ring_shard.py's SMALL_GEOM —
+# parity there is pinned against the global engine at the same geometry,
+# so the wire shapes audited here are the shapes the parity pin covers.
+# ---------------------------------------------------------------------------
+
+SMALL_GEOM = dict(suspicion_mult=1.0, k_indirect=1, max_piggyback=2,
+                  ring_window_periods=2, ring_view_c=2)
+
+WIRE_ARMS = (
+    ("window+wide", {}),
+    ("window+packed", {"ring_sel_scope": "period",
+                       "ring_scalar_wire": "packed"}),
+    ("compact+wide", {"ring_sel_scope": "period",
+                      "ring_ici_wire": "compact"}),
+    ("compact+packed", {"ring_sel_scope": "period",
+                        "ring_ici_wire": "compact",
+                        "ring_scalar_wire": "packed"}),
+)
+
+# Bookkeeping ceiling for all-gather payloads (elements): OB*D candidate
+# keys — far below one shard's node rows.  Same constant the historical
+# test pin used.
+ALLGATHER_MAX_ELEMS = 2048
+
+MEMWALL_ARTIFACT = os.path.join("bench_results", "memwall_report.json")
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None:
+            continue
+        elems = 1
+        for dim in shape:
+            elems *= int(dim)
+        total += elems * dtype.itemsize
+    return total
+
+
+def _program_sweep(n: int, capacity: int = 4):
+    """Three FaultProgram VALUES at one capacity — the retrace sweep."""
+    from swim_tpu.sim import faults
+
+    base = faults.as_program(faults.none(n), capacity=capacity)
+    gray = faults.with_segment(base, 0, start=1, end=6, kind="gray",
+                               level=0.5)
+    lossy = faults.with_segment(
+        faults.as_program(faults.none(n), capacity=capacity),
+        0, start=2, end=5, kind="link_loss", level=0.3)
+    return (base, gray, lossy)
+
+
+def run_audit(wire_n: int = 512, retrace_n: int = 256, d: int = 8,
+              periods: int = 4, repo_root: str | None = None) -> dict:
+    """Run every contract arm and return the (byte-stable) report dict.
+
+    Deviceless: traces and AOT-compiles on the host mesh, never executes
+    on hardware beyond tiny retrace-probe runs.  Needs `d` devices
+    (tests/CLI force the 8-device virtual CPU mesh).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from swim_tpu import SwimConfig
+    from swim_tpu.models import dense, ring, rumor
+    from swim_tpu.parallel import mesh as pmesh, ring_shard
+    from swim_tpu.obs import ici
+    from swim_tpu.sim import faults, runner
+
+    if len(jax.devices()) < d:
+        raise RuntimeError(
+            f"audit needs {d} devices, have {len(jax.devices())} — run via "
+            "'swim-tpu audit' (which forces the virtual CPU mesh) or set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8")
+    root = repo_root or os.getcwd()
+    mesh = pmesh.make_mesh(d)
+    key = jax.random.key(0)
+
+    checks: dict[str, list[dict]] = {name: [] for name in CONTRACTS}
+    totals = {"retraces_extra": 0, "unattributed_collective_bytes": 0,
+              "undonated_bytes": 0, "barrier_chains_missing": 0}
+
+    def add(contract: str, arm: str, ok: bool, detail: str) -> None:
+        checks[contract].append(
+            {"arm": arm, "ok": bool(ok), "detail": str(detail)})
+
+    # -- retrace budget: one compile per arm across a program-value sweep --
+    progs = _program_sweep(retrace_n)
+    retrace_arms = (
+        ("dense", runner.run_study, (0, 4), (1,), dense.init_state, ()),
+        ("rumor", runner.run_study_rumor, (0, 4, 5), (1,),
+         rumor.init_state, (None,)),
+        ("ring", runner.run_study_ring, (0, 4, 5), (1,),
+         ring.init_state, (None,)),
+    )
+    cfg_r = SwimConfig(n_nodes=retrace_n, **SMALL_GEOM)
+    for name, jitted, static, donate, init, extra in retrace_arms:
+        traces = []
+        body = jitted.__wrapped__
+
+        def counted(*a, _body=body, _traces=traces):
+            _traces.append(1)
+            return _body(*a)
+
+        probe = jax.jit(counted, static_argnums=static,
+                        donate_argnums=donate)
+        for prog in progs:
+            probe(cfg_r, init(cfg_r), prog, key, periods, *extra)
+        extra_traces = max(0, len(traces) - 1)
+        totals["retraces_extra"] += extra_traces
+        add("retrace_budget", name, len(traces) == 1,
+            f"{len(traces)} trace(s) over {len(progs)} program values")
+
+    # streaming chunk: two plan values through one jitted chunk
+    chunk_traces = []
+    chunk_body = runner._run_study_ring_chunk.__wrapped__
+
+    def counted_chunk(*a):
+        chunk_traces.append(1)
+        return chunk_body(*a)
+
+    chunk_probe = jax.jit(counted_chunk, static_argnums=(0, 5, 6),
+                          donate_argnums=(1, 2))
+    for crash_at in (2, 3):
+        plan_v = faults.with_crashes(faults.none(retrace_n), [5], [crash_at])
+        state_v = ring.init_state(cfg_r)
+        track_v = runner.compact_track_init(plan_v, periods)
+        chunk_probe(cfg_r, state_v, track_v, plan_v, key, 0, None)
+    totals["retraces_extra"] += max(0, len(chunk_traces) - 1)
+    add("retrace_budget", "ring_stream_chunk", len(chunk_traces) == 1,
+        f"{len(chunk_traces)} trace(s) over 2 plan values")
+
+    # sharded step: jit cache must hold ONE entry across program values
+    cfg_s = SwimConfig(n_nodes=retrace_n, ring_sel_scope="period",
+                       ring_ici_wire="compact", ring_scalar_wire="packed",
+                       **SMALL_GEOM)
+    step_s = jax.jit(ring_shard.mapped_step(cfg_s, mesh, program=True))
+    rnd_s = ring.draw_period_ring(key, 0, cfg_s)
+    for prog in progs[:2]:
+        st_p, pl_p = ring_shard.place(cfg_s, mesh,
+                                      ring.init_state(cfg_s), prog)
+        step_s(st_p, pl_p, rnd_s)
+    cache = step_s._cache_size()
+    totals["retraces_extra"] += max(0, cache - 1)
+    add("retrace_budget", "ringshard", cache == 1,
+        f"{cache} compiled entrie(s) over 2 program values")
+
+    # -- donation coverage: AOT alias bytes == donated bytes, exactly --
+    plan_d = faults.with_crashes(faults.none(retrace_n), [5], [2])
+    state_ring = ring.init_state(cfg_r)
+    track_d = runner.compact_track_init(plan_d, periods)
+    states_b = runner.batch_states([dense.init_state(cfg_r)] * 2)
+    plans_b = runner.batch_states(list(_program_sweep(retrace_n)[:2]))
+    keys_b = jax.random.split(key, 2)
+    donation_arms = (
+        ("dense", runner.run_study,
+         (cfg_r, dense.init_state(cfg_r), plan_d, key, periods),
+         lambda a: (a[1],)),
+        ("rumor", runner.run_study_rumor,
+         (cfg_r, rumor.init_state(cfg_r), plan_d, key, periods, None),
+         lambda a: (a[1],)),
+        ("ring", runner.run_study_ring,
+         (cfg_r, state_ring, plan_d, key, periods, None),
+         lambda a: (a[1],)),
+        ("ring_stream_chunk", runner._run_study_ring_chunk,
+         (cfg_r, ring.init_state(cfg_r), track_d, plan_d, key, 0, None),
+         lambda a: (a[1], a[2])),
+        ("batch", runner.run_study_batch,
+         (cfg_r, states_b, plans_b, keys_b, periods, "dense", None),
+         lambda a: (a[1],)),
+    )
+    for name, jitted, args, donated_of in donation_arms:
+        analysis = jitted.lower(*args).compile().memory_analysis()
+        alias = int(analysis.alias_size_in_bytes)
+        donated = sum(_tree_bytes(t) for t in donated_of(args))
+        totals["undonated_bytes"] += max(0, donated - alias)
+        add("donation_coverage", name, alias == donated,
+            f"alias_bytes={alias} donated_bytes={donated}")
+
+    # -- wire, tally, hygiene over the 2x2 sharded wire matrix --
+    shard_rows = wire_n // d
+    ppermute_bytes_by_arm: dict[str, int] = {}
+    for arm_name, overrides in WIRE_ARMS:
+        cfg_w = SwimConfig(n_nodes=wire_n, **SMALL_GEOM, **overrides)
+        plan_w = faults.with_crashes(faults.none(wire_n), [5], [2])
+        st_w, pl_w = ring_shard.place(cfg_w, mesh,
+                                      ring.init_state(cfg_w), plan_w)
+        rnd_w = ring.draw_period_ring(key, 0, cfg_w)
+        mapped = ring_shard.mapped_step(cfg_w, mesh)
+        jpr = jax.make_jaxpr(mapped)(st_w, pl_w, rnd_w)
+        hlo = jax.jit(mapped).lower(st_w, pl_w, rnd_w).compile().as_text()
+        records = scan_hlo_collectives(hlo)
+
+        cperms = [r for r in records if r["op"] == "collective-permute"]
+        problems = []
+        if not cperms:
+            problems.append("no collective-permute wave rolls")
+        packed = cfg_w.ring_scalar_wire == "packed"
+        if packed:
+            if not any(p["dtype"] == "u8" for p in cperm_payloads(records)):
+                problems.append("no u8 cperm payload on the packed wire")
+            wide_lanes = sorted({
+                f"{p['dtype']}[{p['elems']}]"
+                for p in cperm_payloads(records)
+                if p["dtype"] in ("s32", "pred")
+                and p["elems"] == shard_rows})
+            if wide_lanes:
+                problems.append(
+                    f"[S]-shaped scalar lanes on the packed wire: "
+                    f"{wide_lanes}")
+        ag_worst = max_payload_elems(records, "all-gather")
+        if ag_worst > ALLGATHER_MAX_ELEMS:
+            problems.append(
+                f"all-gather payload {ag_worst} elems > bookkeeping "
+                f"ceiling {ALLGATHER_MAX_ELEMS}")
+        add("wire_contracts", arm_name, not problems,
+            "; ".join(problems) if problems
+            else f"{len(cperms)} cperm instruction(s), "
+                 f"all-gather max {ag_worst} elems")
+
+        family_bytes = jaxpr_collective_bytes(jpr.jaxpr)
+        ppermute_bytes_by_arm[arm_name] = int(
+            family_bytes.get("ppermute", 0))
+        tally = ici.trace_ici_bytes(cfg_w, d)
+        unattributed = tally_unattributed(family_bytes,
+                                          tally["breakdown"])
+        loose = {k: v for k, v in unattributed.items() if v}
+        totals["unattributed_collective_bytes"] += sum(loose.values())
+        add("ici_tally_completeness", arm_name, not loose,
+            f"unattributed={loose}" if loose
+            else f"traced={ {k: int(v) for k, v in sorted(family_bytes.items())} } "
+                 "fully attributed")
+
+        violations = jaxpr_hygiene_violations(jpr.jaxpr)
+        add("hot_path_hygiene", f"ringshard/{arm_name}", not violations,
+            "; ".join(violations) if violations else "clean")
+
+    compact_b = ppermute_bytes_by_arm["compact+packed"]
+    wide_b = ppermute_bytes_by_arm["window+wide"]
+    add("wire_contracts", "compact_vs_window", 0 < compact_b < wide_b,
+        f"ppermute bytes/period/chip: compact+packed={compact_b} "
+        f"window+wide={wide_b}")
+
+    # -- hygiene over the study bodies (whole traced study, per engine) --
+    prog_h = progs[0]
+    hygiene_arms = (
+        ("dense", lambda: jax.make_jaxpr(
+            lambda s, p, k: runner.run_study.__wrapped__(
+                cfg_r, s, p, k, periods))(
+            dense.init_state(cfg_r), prog_h, key)),
+        ("rumor", lambda: jax.make_jaxpr(
+            lambda s, p, k: runner.run_study_rumor.__wrapped__(
+                cfg_r, s, p, k, periods, None))(
+            rumor.init_state(cfg_r), prog_h, key)),
+        ("ring", lambda: jax.make_jaxpr(
+            lambda s, p, k: runner.run_study_ring.__wrapped__(
+                cfg_r, s, p, k, periods, None))(
+            ring.init_state(cfg_r), prog_h, key)),
+    )
+    for name, trace in hygiene_arms:
+        violations = jaxpr_hygiene_violations(trace().jaxpr)
+        add("hot_path_hygiene", f"study/{name}", not violations,
+            "; ".join(violations) if violations else "clean")
+
+    # -- barrier survival --
+    up = jnp.ones((retrace_n,), jnp.bool_)
+    census_forced = jax.make_jaxpr(
+        lambda s, u: ring.live_knower_counts(cfg_r, s, u,
+                                             pair_budget=4 * retrace_n))(
+        ring.init_state(cfg_r), up)
+    n_forced = jaxpr_count_primitive(census_forced.jaxpr,
+                                     "optimization_barrier")
+    if n_forced < 2:
+        totals["barrier_chains_missing"] += 1
+    add("barrier_survival", "census_chunked", n_forced >= 2,
+        f"{n_forced} optimization_barrier eqn(s) in the chunked census "
+        "chain (floor 2)")
+
+    cfg_pull = SwimConfig(n_nodes=retrace_n, ring_probe="pull",
+                          **SMALL_GEOM)
+    plan_p = faults.none(retrace_n)
+    rnd_p = ring.draw_period_ring(key, 0, cfg_pull)
+    pull_jpr = jax.make_jaxpr(
+        lambda s, r: ring.step(cfg_pull, s, plan_p, r))(
+        ring.init_state(cfg_pull), rnd_p)
+    n_pull = jaxpr_count_primitive(pull_jpr.jaxpr, "optimization_barrier")
+    if n_pull < 1:
+        totals["barrier_chains_missing"] += 1
+    add("barrier_survival", "pull_gather_step", n_pull >= 1,
+        f"{n_pull} optimization_barrier eqn(s) in the pull-probe step "
+        "(floor 1)")
+
+    # sharded GSPMD survival: read the committed 64M AOT row.  A
+    # compile-OOM there IS the chain dying under the sharded lowering —
+    # waived (ROADMAP item 2) until re-pinned.
+    memwall_path = os.path.join(root, MEMWALL_ARTIFACT)
+    if os.path.exists(memwall_path):
+        with open(memwall_path) as fh:
+            rows = json.load(fh).get("rows", [])
+        shard_rows_64m = [r for r in rows
+                          if r.get("engine") == "ringshard"
+                          and int(r.get("n", 0)) >= 64_000_000]
+        if shard_rows_64m:
+            oomed = any(r.get("compile_oom") for r in shard_rows_64m)
+            add("barrier_survival", "sharded_gspmd_64m", not oomed,
+                "64M ringshard AOT row compile-OOMs (census chain dropped "
+                "under GSPMD)" if oomed
+                else "64M ringshard AOT row compiles within accounting")
+        else:
+            add("barrier_survival", "sharded_gspmd_64m", True,
+                "no >=64M ringshard row in memwall artifact (nothing to "
+                "check)")
+    else:
+        add("barrier_survival", "sharded_gspmd_64m", True,
+            "memwall artifact absent (nothing to check)")
+
+    # -- assemble, apply waivers --
+    waived_keys = {(w["contract"], w["arm"]): w for w in WAIVERS}
+    contracts_out = {}
+    n_checks = n_failed = n_waived = 0
+    for contract in sorted(CONTRACTS):
+        arm_rows = []
+        worst = "pass"
+        for row in checks[contract]:
+            n_checks += 1
+            status = "pass"
+            if not row["ok"]:
+                waiver = waived_keys.get((contract, row["arm"]))
+                if waiver is not None:
+                    status = "waived"
+                    n_waived += 1
+                    row = dict(row, waived_by=waiver["pointer"])
+                else:
+                    status = "fail"
+                    n_failed += 1
+            arm_rows.append(dict(row, status=status))
+            if status == "fail":
+                worst = "fail"
+            elif status == "waived" and worst != "fail":
+                worst = "waived"
+        contracts_out[contract] = {
+            "description": CONTRACTS[contract],
+            "status": worst,
+            "checks": arm_rows,
+        }
+
+    totals.update(checks_total=n_checks, failures=n_failed,
+                  waived=n_waived)
+    return {
+        "schema": 1,
+        "platform": jax.devices()[0].platform,
+        "devices": d,
+        "wire_n": wire_n,
+        "retrace_n": retrace_n,
+        "periods": periods,
+        "contracts": contracts_out,
+        "waivers": list(WAIVERS),
+        "totals": totals,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Report plumbing: checking, byte-stable writing, gauges.
+# ---------------------------------------------------------------------------
+
+def check_report(report: dict) -> tuple[bool, list[str]]:
+    """(ok, failures) — failures list unwaived failing checks by name."""
+    failures = []
+    for contract in sorted(report["contracts"]):
+        for row in report["contracts"][contract]["checks"]:
+            if row["status"] == "fail":
+                failures.append(
+                    f"{contract}/{row['arm']}: {row['detail']}")
+    return (not failures), failures
+
+
+def write_report(report: dict, path: str) -> None:
+    """Atomic, byte-stable write: sorted keys, no timestamps, trailing
+    newline — reruns of the same tree produce the identical file."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    text = json.dumps(report, indent=2, sort_keys=True) + "\n"
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=".audit_")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            fh.write(text)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+AUDIT_GAUGES = {
+    "swim_audit_checks_total":
+        "contract checks evaluated in the last audit run",
+    "swim_audit_failures_total":
+        "unwaived failing contract checks (CI-red)",
+    "swim_audit_waived_total":
+        "failing checks covered by an expected-fail waiver",
+    "swim_audit_retraces_extra_total":
+        "retraces beyond the one-compile-per-arm budget",
+    "swim_audit_unattributed_collective_bytes":
+        "traced collective bytes not attributed to a named obs/ici.py "
+        "tally term",
+    "swim_audit_undonated_bytes":
+        "donated-argument bytes not aliased in the compiled executable",
+    "swim_audit_barrier_chains_missing":
+        "barrier arms whose ordering chain fell below the contract floor",
+}
+
+
+def gauge_values(report: dict) -> dict[str, int | float]:
+    """Metric name -> value for obs/expo.py (one per AUDIT_GAUGES key)."""
+    totals = report["totals"]
+    return {
+        "swim_audit_checks_total": totals["checks_total"],
+        "swim_audit_failures_total": totals["failures"],
+        "swim_audit_waived_total": totals["waived"],
+        "swim_audit_retraces_extra_total": totals["retraces_extra"],
+        "swim_audit_unattributed_collective_bytes":
+            totals["unattributed_collective_bytes"],
+        "swim_audit_undonated_bytes": totals["undonated_bytes"],
+        "swim_audit_barrier_chains_missing":
+            totals["barrier_chains_missing"],
+    }

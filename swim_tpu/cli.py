@@ -16,6 +16,10 @@ Subcommands:
               top-op table
   trend     — jax-free per-tier bench trajectories over BENCH_r*.json
               + bench_results/, with a --check regression gate
+  audit     — static contract audit (analysis/audit.py): retrace
+              budget, donation coverage, wire payloads, ICI tally
+              completeness, barrier survival, hot-path hygiene —
+              verified deviceless against the jaxpr and AOT HLO
 """
 
 from __future__ import annotations
@@ -535,6 +539,37 @@ def _cmd_scenario(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_audit(args: argparse.Namespace) -> int:
+    # the wire arms need the 8-device mesh regardless of --platform;
+    # force_cpu is first-writer-wins and safe before any device query
+    from swim_tpu.utils.platform import force_cpu
+
+    force_cpu(8)
+    from swim_tpu.analysis import audit
+
+    report = audit.run_audit(wire_n=args.wire_n, retrace_n=args.retrace_n)
+    if args.out:
+        audit.write_report(report, args.out)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for contract in sorted(report["contracts"]):
+            blob = report["contracts"][contract]
+            print(f"[{blob['status']:>6}] {contract}")
+            for row in blob["checks"]:
+                mark = {"pass": ".", "waived": "w"}.get(row["status"], "F")
+                print(f"   {mark} {row['arm']}: {row['detail']}")
+        totals = report["totals"]
+        print(f"{totals['checks_total']} checks, "
+              f"{totals['failures']} failed, {totals['waived']} waived")
+    ok, failures = audit.check_report(report)
+    if args.check and not ok:
+        for line in failures:
+            print(f"AUDIT FAIL {line}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="swim-tpu",
@@ -769,6 +804,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serve Prometheus text exposition on GET "
                          "/metrics at this port (0 = ephemeral)")
     br.set_defaults(fn=_cmd_bridge)
+
+    au = sub.add_parser(
+        "audit", help="static contract audit: retrace/donation/wire/"
+                      "tally/barrier/hygiene invariants verified against "
+                      "the jaxpr and AOT HLO, deviceless "
+                      "(swim_tpu/analysis/audit.py)")
+    au.add_argument("--out", default="bench_results/audit_report.json",
+                    help="report path ('' skips writing)")
+    au.add_argument("--wire-n", type=int, default=512,
+                    help="node count for the 2x2 sharded wire arms")
+    au.add_argument("--retrace-n", type=int, default=256,
+                    help="node count for retrace/donation/barrier arms")
+    au.add_argument("--json", action="store_true",
+                    help="print the full report JSON")
+    au.add_argument("--check", action="store_true",
+                    help="exit 1 on any unwaived contract failure")
+    au.set_defaults(fn=_cmd_audit)
     return p
 
 

@@ -245,3 +245,28 @@ def render_memwall(report: dict,
                      f"{_fmt_float(values[full])}")
     assert set(values) == set(MEM_GAUGES)
     return "\n".join(lines) + "\n"
+
+
+def render_audit(report: dict,
+                 labels: dict[str, str] | None = None) -> str:
+    """One analysis/audit.py contract report as swim_audit_* gauges
+    (names pinned in audit.AUDIT_GAUGES and linted against this renderer
+    by scripts/check_metrics_registry.py).  Point-in-time like the
+    memwall gauges; series carry the audited shapes and compile platform
+    as labels so audits at different arms never alias."""
+    # import-time jax-free: analysis/audit.py defers jax to run time
+    from swim_tpu.analysis.audit import AUDIT_GAUGES, gauge_values
+
+    base = {**(labels or {}),
+            "wire_nodes": str(report.get("wire_n", "?")),
+            "retrace_nodes": str(report.get("retrace_n", "?")),
+            "platform": str(report.get("platform", "?"))}
+    lines: list[str] = []
+    values = gauge_values(report)
+    for full, help_text in AUDIT_GAUGES.items():
+        lines.append(f"# HELP {full} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {full} gauge")
+        lines.append(f"{full}{_fmt_labels(base)} "
+                     f"{_fmt_float(values[full])}")
+    assert set(values) == set(AUDIT_GAUGES)
+    return "\n".join(lines) + "\n"

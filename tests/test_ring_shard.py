@@ -12,14 +12,13 @@ Two guarantees:
 
 from __future__ import annotations
 
-import re
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from swim_tpu import SwimConfig
+from swim_tpu.analysis import audit
 from swim_tpu.models import ring
 from swim_tpu.parallel import mesh as pmesh, ring_shard
 from swim_tpu.sim import faults
@@ -250,37 +249,37 @@ class TestStudyPath:
         assert a == b
 
 
+def _step_hlo(cfg, n):
+    """AOT HLO text of the sharded step at `cfg` (8-way mesh)."""
+    mesh = pmesh.make_mesh(8)
+    plan = faults.with_crashes(faults.none(n), [5], [2])
+    s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
+                                       plan)
+    rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
+    step = ring_shard.build_step(cfg, mesh)
+    return step.lower(s_state, s_plan, rnd).compile().as_text()
+
+
 class TestCommunicationPattern:
+    """Wire pins via analysis/audit.py's collective scanner — the SAME
+    implementation `swim-tpu audit` runs, so the test pin and the
+    auditor can never drift apart."""
+
     def test_no_large_allgathers(self):
         """The step's HLO moves waves with collective-permute; any
         all-gather is small bookkeeping (candidate keys, psum plumbing),
-        never a win-sized or node-vector-sized tensor."""
+        never a win-sized or node-vector-sized tensor.  The scanner
+        takes the LARGEST shape on each instruction line (sync and
+        async-start tuple forms alike), so a win-sized operand can't
+        hide in a tuple."""
         n = 4096
-        cfg = SwimConfig(n_nodes=n)
-        mesh = pmesh.make_mesh(8)
-        plan = faults.with_crashes(faults.none(n), [5], [2])
-        s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
-                                           plan)
-        rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
-        step = ring_shard.build_step(cfg, mesh)
-        txt = step.lower(s_state, s_plan, rnd).compile().as_text()
-
-        assert "collective-permute" in txt, "wave rolls must use ppermute"
-        # every all-gather's element count must be small bookkeeping —
-        # far below one shard's node rows (n/8), let alone full win.
-        # Scan whole instruction lines (covers sync all-gather AND async
-        # all-gather-start tuple forms) and take the LARGEST shape on
-        # the line, so a win-sized operand can't hide in a tuple.
-        big = []
-        for line in txt.splitlines():
-            if "all-gather" not in line or "=" not in line:
-                continue
-            counts = [int(np.prod([int(d) for d in m.group(1).split(",")]))
-                      for m in re.finditer(r"\w+\[([\d,]+)\]", line)]
-            worst = max(counts, default=1)
-            if worst > 2048:        # OB*D = 512 keys is the honest max
-                big.append((worst, line.strip()[:120]))
-        assert not big, f"replication-scale all-gathers: {big}"
+        records = audit.scan_hlo_collectives(
+            _step_hlo(SwimConfig(n_nodes=n), n))
+        assert any(r["op"] == "collective-permute" for r in records), \
+            "wave rolls must use ppermute"
+        worst = audit.max_payload_elems(records, "all-gather")
+        assert worst <= audit.ALLGATHER_MAX_ELEMS, \
+            f"replication-scale all-gather: {worst} elems"
 
     def test_compact_wire_moves_packed_payloads(self):
         """With ring_ici_wire='compact' the wave exchanges must ship
@@ -291,25 +290,13 @@ class TestCommunicationPattern:
         n = 4096
         cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
                          ring_ici_wire="compact", **SMALL_GEOM)
-        mesh = pmesh.make_mesh(8)
-        plan = faults.with_crashes(faults.none(n), [5], [2])
-        s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
-                                           plan)
-        rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
-        step = ring_shard.build_step(cfg, mesh)
-        txt = step.lower(s_state, s_plan, rnd).compile().as_text()
-
-        cperms = [l for l in txt.splitlines() if "collective-permute" in l
-                  and "=" in l]
-        assert cperms, "wave rolls must use ppermute"
-        assert any("u8[" in l for l in cperms), \
+        records = audit.scan_hlo_collectives(_step_hlo(cfg, n))
+        payloads = audit.cperm_payloads(records)
+        assert payloads, "wave rolls must use ppermute"
+        assert any(p["dtype"] == "u8" for p in payloads), \
             "no packed (u8) collective-permute payload found"
-        for line in txt.splitlines():
-            if "all-gather" not in line or "=" not in line:
-                continue
-            counts = [int(np.prod([int(d) for d in m.group(1).split(",")]))
-                      for m in re.finditer(r"\w+\[([\d,]+)\]", line)]
-            assert max(counts, default=1) <= 2048, line[:120]
+        assert audit.max_payload_elems(records, "all-gather") \
+            <= audit.ALLGATHER_MAX_ELEMS
 
     def test_packed_scalar_wire_moves_packed_words(self):
         """With ring_scalar_wire='packed' the scalar wave exchanges must
@@ -323,21 +310,12 @@ class TestCommunicationPattern:
         cfg = SwimConfig(n_nodes=n, ring_sel_scope="period",
                          ring_ici_wire="compact",
                          ring_scalar_wire="packed", **SMALL_GEOM)
-        mesh = pmesh.make_mesh(8)
-        plan = faults.with_crashes(faults.none(n), [5], [2])
-        s_state, s_plan = ring_shard.place(cfg, mesh, ring.init_state(cfg),
-                                           plan)
-        rnd = ring.draw_period_ring(jax.random.key(0), 0, cfg)
-        step = ring_shard.build_step(cfg, mesh)
-        txt = step.lower(s_state, s_plan, rnd).compile().as_text()
-
-        # only TRUE collective-permute instructions (sync or async
-        # start), not downstream fusions that consume a permute result
-        cperms = [l for l in txt.splitlines()
-                  if re.search(r"collective-permute(-start)?\(", l)]
-        assert cperms, "wave rolls must use ppermute"
-        assert any("u8[" in l for l in cperms), \
+        records = audit.scan_hlo_collectives(_step_hlo(cfg, n))
+        payloads = audit.cperm_payloads(records)
+        assert payloads, "wave rolls must use ppermute"
+        assert any(p["dtype"] == "u8" for p in payloads), \
             "no packed (u8) collective-permute payload found"
-        wide = [l.strip()[:120] for l in cperms
-                if re.search(r"(s32|pred)\[512\]", l)]
+        wide = [f"{p['dtype']}[{p['elems']}]" for p in payloads
+                if p["dtype"] in ("s32", "pred")
+                and p["elems"] == n // 8]
         assert not wide, f"dtype-wide scalar lanes still on ICI: {wide}"

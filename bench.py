@@ -865,6 +865,25 @@ def bench_audit(n_nodes: int, periods: int) -> dict:
     }
 
 
+def bench_serve(n_nodes: int, periods: int) -> dict:
+    """Serving-hub load tier (swim_tpu/serve): ~10^3 concurrent
+    datagram sessions admitted onto one ring engine, clean arm vs
+    replay/duplication storm arm.
+
+    Defended metrics: admission sessions/sec and p50/p99 echo RTT (ms);
+    `ok_parity` carries the arm-parity verdict — the storm's duplicated
+    and replayed session traffic must leave engine state bitwise
+    identical and admit every session.  The `serve_sessions` /
+    `serve_p99_ms` trend series register in obs/trend.py (p99 inverts
+    like the bytes families: a latency RISE is the regression)."""
+    from swim_tpu.serve import load as serve_load
+
+    n = n_nodes or 1_000_000
+    sessions = 1000 if n >= 100_000 else 64
+    return serve_load.run_load(n_nodes=n, sessions=sessions,
+                               periods=max(periods or 3, 2))
+
+
 TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
             "ringp": functools.partial(bench_ring,
@@ -901,7 +920,7 @@ def run_tier_child(args) -> int:
         jax.config.update("jax_platforms", args.platform)
     # else ("default"/"auto"): leave the ambient platform alone.
     if args._tier in ("telemetry", "profiler", "scenariobatch",
-                      "memwall", "audit"):
+                      "memwall", "audit", "serve"):
         # Artifact tiers share one shape: run a self-contained contract
         # measurement (on/off overhead at the lean anchor, the
         # batched-vs-serial scenario fleet, or the AOT memory-wall
@@ -910,10 +929,12 @@ def run_tier_child(args) -> int:
               "profiler": bench_profiler_overhead,
               "scenariobatch": bench_scenario_batch,
               "memwall": bench_memwall,
-              "audit": bench_audit}[args._tier]
+              "audit": bench_audit,
+              "serve": bench_serve}[args._tier]
         artifact = {"scenariobatch": "scenariobatch_fleet.json",
                     "memwall": "memwall_report.json",
-                    "audit": "audit_bench.json"}.get(
+                    "audit": "audit_bench.json",
+                    "serve": "serve_load.json"}.get(
                         args._tier, f"{args._tier}_overhead.json")
         try:
             import jax
@@ -930,6 +951,10 @@ def run_tier_child(args) -> int:
                     "audit":
                         "unwaived contract failure(s): "
                         + "; ".join(res.get("failed_checks", []))[:300],
+                    "serve":
+                        "serve arms diverged (storm-vs-clean state "
+                        "digest, or a session failed admission) — "
+                        "latency/admission numbers not publishable",
                 }.get(args._tier,
                       "batched fleet diverged from serial "
                       "(lane bitwise or verdict parity) — "
@@ -1046,8 +1071,8 @@ def main() -> int:
                     choices=("dense", "rumor", "shard", "ring", "ringp",
                              "ringpull", "ringshard", "ringshardc",
                              "telemetry", "profiler", "scenariobatch",
-                             "memwall", "audit", "flagship", "both",
-                             "all"))
+                             "memwall", "audit", "serve", "flagship",
+                             "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
     ap.add_argument("--platform", default="auto",
@@ -1136,6 +1161,12 @@ def main() -> int:
             # wire-matrix N (compile-bound: smoke shrinks it)
             nodes = args.nodes or (256 if args.smoke else 512)
             p = args.periods or 4
+        if tier == "serve":
+            # the load harness defends >=1,000 sessions against a
+            # >=1M-node engine (CPU-host capable: LEAN-anchor
+            # geometry); smoke shrinks to a 4096-node hub smoke
+            nodes = args.nodes or (4096 if args.smoke else 1_000_000)
+            p = args.periods or 3
         if tier in ("rumor", "shard") and nodes >= 262_144 \
                 and not args.periods:
             # The scatter-delivery engines serialize their updates on
@@ -1239,6 +1270,36 @@ def main() -> int:
                    "platform": platform, "error": r.get("error")}
             out.update({k: v for k, v in r.items()
                         if k not in ("ok", "error", "report")})
+        out.update(info)
+        print(json.dumps(out))
+        return 0
+
+    if args.tier == "serve":
+        # Serving-hub tier: the headline is the clean arm's p99 echo
+        # RTT.  Two trend series auto-register with obs/trend.py:
+        # "serve_sessions" (concurrent sessions sustained — regresses
+        # by dropping) and "serve_p99_ms" (gate INVERTS like the bytes
+        # families — a latency rise is the regression), both keyed on
+        # "serve_nodes".  ok_parity carries the storm-vs-clean bitwise
+        # verdict for the tpu_watch payload check.
+        r = results.get(args.tier, {})
+        if r.get("ok"):
+            out = {"metric": (f"serve p99 echo RTT @ {r['nodes']} nodes "
+                              f"x {r['sessions']} sessions "
+                              f"({r['frontend']} frontend, {platform})"),
+                   "value": r["p99_rtt_ms"], "unit": "ms",
+                   "platform": platform,
+                   "ok_parity": True,
+                   "serve_nodes": r["nodes"],
+                   "serve_sessions": r["sessions"],
+                   "serve_p99_ms": r["p99_rtt_ms"]}
+            out.update({k: v for k, v in r.items()
+                        if k not in ("ok", "clean", "storm")})
+        else:
+            out = {"metric": (f"serve p99 echo RTT (tier failed, "
+                              f"{platform})"),
+                   "value": -1.0, "unit": "ms", "platform": platform,
+                   "ok_parity": False, "error": r.get("error")}
         out.update(info)
         print(json.dumps(out))
         return 0

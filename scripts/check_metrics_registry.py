@@ -259,6 +259,62 @@ def check_audit_gauges() -> list[str]:
     return problems
 
 
+def check_session_gauges() -> list[str]:
+    """Problems with the swim_session_* gauge surface ([] = clean).
+
+    Mirrors check_mem_gauges/check_audit_gauges for the serving hub:
+    (a) the literal `swim_session_*` keys in serve/hub.py gauge_values
+    (AST source scan) must be exactly hub.SESSION_GAUGES; (b)
+    render_sessions over a synthetic report — including a per-session
+    table, since clock lag renders one labeled series per session —
+    must emit exactly the SESSION_GAUGES names; (c) every name must be
+    a legal Prometheus metric name.
+    """
+    import re
+
+    from swim_tpu.obs.expo import render_sessions
+    from swim_tpu.serve.hub import SESSION_GAUGES
+
+    problems: list[str] = []
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for name in SESSION_GAUGES:
+        if not name_re.match(name):
+            problems.append(f"SESSION_GAUGES entry {name!r} is not a "
+                            "legal Prometheus metric name")
+    hub_py = os.path.join(os.path.dirname(NODE_PY), os.pardir,
+                          "serve", "hub.py")
+    with open(hub_py) as f:
+        tree = ast.parse(f.read(), filename=hub_py)
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "gauge_values"), None)
+    if fn is None:
+        problems.append("serve/hub.py has no gauge_values()")
+    else:
+        written = {n.value for n in ast.walk(fn)
+                   if isinstance(n, ast.Constant)
+                   and isinstance(n.value, str)
+                   and n.value.startswith("swim_session_")}
+        if written != set(SESSION_GAUGES):
+            problems.append(
+                f"hub.gauge_values writes {sorted(written)} but "
+                f"SESSION_GAUGES declares {sorted(SESSION_GAUGES)} — "
+                "keep the two in lockstep")
+    fake = {"nodes": 8, "admitted": 2, "evicted": 1, "active": 1,
+            "mirror_bytes_per_period": 16,
+            "sessions": [{"row": 3, "clock_lag_periods": 0},
+                         {"row": 5, "clock_lag_periods": 2}]}
+    emitted = {line.split("{")[0].split(" ")[0]
+               for line in render_sessions(fake).splitlines()
+               if line and not line.startswith("#")}
+    if emitted != set(SESSION_GAUGES):
+        problems.append(
+            f"render_sessions emits {sorted(emitted)} but "
+            f"SESSION_GAUGES declares {sorted(SESSION_GAUGES)} — keep "
+            "the renderer and the gauge table in lockstep")
+    return problems
+
+
 def check_ici_terms() -> list[str]:
     """Problems with the auditor's ICI tally vocabulary ([] = clean).
 
@@ -388,23 +444,23 @@ def check_trend_tier_keys() -> list[str]:
         src = f.read()
     pps = set(re.findall(r'"([a-z0-9]+)_periods_per_sec"', src))
     peak = set(re.findall(r'"([a-z0-9]+)_peak_bytes"', src))
+    sessions = set(re.findall(r'"([a-z0-9]+)_sessions"', src))
+    p99 = set(re.findall(r'"([a-z0-9]+)_p99_ms"', src))
     nodes = set(re.findall(r'"([a-z0-9]+)_nodes"', src))
     problems: list[str] = []
-    for tier in sorted(pps - nodes):
-        problems.append(
-            f"bench.py writes \"{tier}_periods_per_sec\" but never "
-            f"\"{tier}_nodes\" — the trend engine needs both to "
-            "register the series")
-    for tier in sorted(peak - nodes):
-        problems.append(
-            f"bench.py writes \"{tier}_peak_bytes\" but never "
-            f"\"{tier}_nodes\" — the trend engine needs both to "
-            "register the series")
-    for tier in sorted(nodes - (pps | peak)):
+    for suffix, tiers in (("periods_per_sec", pps), ("peak_bytes", peak),
+                          ("sessions", sessions), ("p99_ms", p99)):
+        for tier in sorted(tiers - nodes):
+            problems.append(
+                f"bench.py writes \"{tier}_{suffix}\" but never "
+                f"\"{tier}_nodes\" — the trend engine needs both to "
+                "register the series")
+    for tier in sorted(nodes - (pps | peak | sessions | p99)):
         problems.append(
             f"bench.py writes \"{tier}_nodes\" but no metric key "
-            f"(\"{tier}_periods_per_sec\" or \"{tier}_peak_bytes\") — "
-            "the trend engine needs the pair to register the series")
+            f"(\"{tier}_periods_per_sec\", \"{tier}_peak_bytes\", "
+            f"\"{tier}_sessions\" or \"{tier}_p99_ms\") — the trend "
+            "engine needs the pair to register the series")
     return problems
 
 
@@ -443,6 +499,9 @@ def main() -> int:
     for problem in check_audit_gauges():
         ok = False
         print(f"audit-gauge lint: {problem}", file=sys.stderr)
+    for problem in check_session_gauges():
+        ok = False
+        print(f"session-gauge lint: {problem}", file=sys.stderr)
     for problem in check_ici_terms():
         ok = False
         print(f"ici-term lint: {problem}", file=sys.stderr)
@@ -460,6 +519,7 @@ def main() -> int:
     from swim_tpu.obs.health import HEALTH_RULES
     from swim_tpu.obs.memwall import MEM_GAUGES
     from swim_tpu.obs.prof import PROF_GAUGES
+    from swim_tpu.serve.hub import SESSION_GAUGES
     from swim_tpu.sim.scenario import LIBRARY
 
     print(f"checked {len(keys)} stats keys against "
@@ -468,6 +528,7 @@ def main() -> int:
           f"{len(PROF_GAUGES)} profiler gauges, "
           f"{len(MEM_GAUGES)} memory gauges, "
           f"{len(AUDIT_GAUGES)} audit gauges, "
+          f"{len(SESSION_GAUGES)} session gauges, "
           f"{len(ICI_TERMS)} tally terms and "
           f"{len(LIBRARY)} library scenarios: "
           f"{'OK' if ok else 'FAIL'}")

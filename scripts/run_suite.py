@@ -77,6 +77,7 @@ FAST_FILES = (
     "tests/test_graft_entry.py",
     "tests/test_sampling.py",
     "tests/test_audit.py",
+    "tests/test_serve.py",
 )
 
 # Scenario gate: the library's sub-minute adversarial scenarios, run via

@@ -236,6 +236,17 @@ CAPTURES: list = [
     ("audit",
      ["bench.py", "--tier", "audit", "--tier-timeout", "900"], 1200,
      False, lambda p: bool(p.get("ok_parity"))),
+    # Serving hub load harness: 1000 concurrent sessions against a
+    # 1M-node ring engine, clean arm vs replay/duplication storm.  The
+    # payload check gates on ok_parity — the storm arm must leave the
+    # engine state bitwise identical and both arms must admit every
+    # session; the RTT/admission numbers ride along as serve_* trend
+    # keys.  The harness is host-side (UDP loopback + the free-running
+    # engine thread), so this row measures the chip's step cadence under
+    # mirroring load rather than kernel throughput.
+    ("serve_1m",
+     ["bench.py", "--tier", "serve", "--tier-timeout", "1500"], 1800,
+     False, lambda p: bool(p.get("ok_parity"))),
     # Profile trace: top-op attribution for the optimized ring step.
     ("profile_ring_1m",
      ["scripts/profile_ring.py", "1000000", "--periods", "3",

@@ -104,6 +104,11 @@ ICI_TERM_FAMILIES = {
     ),
     "psum": ("psum_scalar", "gather_psum", "knows_psum"),
     "all_gather": ("candidates_all_gather",),
+    # Host->device placed updates (not traced collectives, priced at a
+    # fixed rate in obs/ici.py): the serving hub's batched row mirror —
+    # one coalesced ExtOriginations placement per device step
+    # (swim_tpu/serve/hub.py).
+    "placed": ("ext_mirror_rows",),
 }
 
 ICI_TERMS = tuple(sorted(
@@ -519,6 +524,7 @@ def run_audit(wire_n: int = 512, retrace_n: int = 256, d: int = 8,
     # -- wire, tally, hygiene over the 2x2 sharded wire matrix --
     shard_rows = wire_n // d
     ppermute_bytes_by_arm: dict[str, int] = {}
+    family_bytes_by_arm: dict[str, dict] = {}
     for arm_name, overrides in WIRE_ARMS:
         cfg_w = SwimConfig(n_nodes=wire_n, **SMALL_GEOM, **overrides)
         plan_w = faults.with_crashes(faults.none(wire_n), [5], [2])
@@ -558,6 +564,7 @@ def run_audit(wire_n: int = 512, retrace_n: int = 256, d: int = 8,
                  f"all-gather max {ag_worst} elems")
 
         family_bytes = jaxpr_collective_bytes(jpr.jaxpr)
+        family_bytes_by_arm[arm_name] = family_bytes
         ppermute_bytes_by_arm[arm_name] = int(
             family_bytes.get("ppermute", 0))
         tally = ici.trace_ici_bytes(cfg_w, d)
@@ -573,6 +580,26 @@ def run_audit(wire_n: int = 512, retrace_n: int = 256, d: int = 8,
         violations = jaxpr_hygiene_violations(jpr.jaxpr)
         add("hot_path_hygiene", f"ringshard/{arm_name}", not violations,
             "; ".join(violations) if violations else "clean")
+
+    # Serving-hub mirroring bytes (swim_tpu/serve): pricing the coalesced
+    # ExtOriginations placement must (a) stay inside the tally vocabulary
+    # (no unknown_term drift), (b) charge exactly 16 bytes per reserved
+    # slot (4 x 4-byte lanes), and (c) leave every traced collective byte
+    # of the dense-wire arm attributed — the completeness contract
+    # extended over the hub's ext seam.
+    from swim_tpu.serve.hub import EXT_CAPACITY as serve_cap
+
+    cfg_s = SwimConfig(n_nodes=wire_n, **SMALL_GEOM)
+    tally_s = ici.trace_ici_bytes(cfg_s, d, ext_capacity=serve_cap)
+    mirror_b = int(tally_s["breakdown"].get("ext_mirror_rows", 0))
+    loose_s = {k: v for k, v in tally_unattributed(
+        family_bytes_by_arm["window+wide"],
+        tally_s["breakdown"]).items() if v}
+    ok_s = mirror_b == 16 * serve_cap and not loose_s
+    totals["unattributed_collective_bytes"] += sum(loose_s.values())
+    add("ici_tally_completeness", "serve_ext_mirror", ok_s,
+        f"ext_mirror_rows={mirror_b} (capacity {serve_cap}), "
+        + (f"unattributed={loose_s}" if loose_s else "fully attributed"))
 
     compact_b = ppermute_bytes_by_arm["compact+packed"]
     wide_b = ppermute_bytes_by_arm["window+wide"]

@@ -70,6 +70,7 @@ import numpy as np
 from swim_tpu.bridge import protocol as bp
 from swim_tpu.config import SwimConfig
 from swim_tpu.core import codec
+from swim_tpu.obs.health import Finding
 from swim_tpu.types import (MsgKind, Status, key_incarnation, key_status,
                             opinion_key)
 
@@ -196,6 +197,7 @@ class EngineBridgeServer:
         self._ping_flushes: dict[int, int] = {}    # flushes with pings
         self._ack_flush: dict[int, int] = {}       # _ping_flushes @ ack
         self._ext_crashed: dict[int, bool] = {x: False for x in self.xs}
+        self.findings: list[Finding] = []   # session_evicted health trail
         self._owner: dict[int, _Session] = {}    # joined id -> session
         self._claimed: set[int] = set()          # ids ever HELLO'd
         self._sessions: list[_Session] = []
@@ -589,6 +591,17 @@ class EngineBridgeServer:
             if lag > self.ack_grace:
                 self.kill(x)
                 self._ext_crashed[x] = True
+                # the old semantics evicted silently ("leaves the
+                # barrier; its rows then miss") — surface it on the
+                # health trail so /metrics and dump headers carry it
+                cause = "ack-grace" if gating else "stall/disconnect"
+                self.findings.append(Finding(
+                    rule="session_evicted", severity="warn",
+                    period=self.t, value=float(lag),
+                    threshold=float(self.ack_grace),
+                    message=f"external id {x} evicted ({cause}): "
+                            f"{lag} periods without an ack; row "
+                            "crash-gated"))
         ext = ring.ext_none(self.ext_capacity)
         with self._lock:
             batch, self._inject = (self._inject[:self.ext_capacity],

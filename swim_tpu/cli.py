@@ -20,6 +20,10 @@ Subcommands:
               budget, donation coverage, wire payloads, ICI tally
               completeness, barrier survival, hot-path hygiene —
               verified deviceless against the jaxpr and AOT HLO
+  serve     — the serving hub (swim_tpu/serve): 'serve bench' runs the
+              10^3-client load harness against a >=1M-node ring engine
+              and defends admission rate + echo RTT p50/p99 under a
+              replay/duplication storm (bitwise state parity)
 """
 
 from __future__ import annotations
@@ -570,6 +574,29 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    if args.action != "bench":
+        print("serve: only the 'bench' action exists (the embeddable "
+              "hub API is swim_tpu.serve.ServeHub)", file=sys.stderr)
+        return 2
+    from swim_tpu.serve import load as serve_load
+
+    res = serve_load.run_load(
+        n_nodes=args.nodes, sessions=args.sessions,
+        periods=args.periods, seed=args.seed,
+        n_sockets=args.sockets, echo_samples=args.echo_samples,
+        frontend=args.frontend)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(res, f, indent=1)
+    print(json.dumps({k: v for k, v in res.items()
+                      if k not in ("clean", "storm")}
+                     if not args.json else res, indent=2))
+    return 0 if res.get("ok_parity") else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="swim-tpu",
@@ -821,6 +848,34 @@ def build_parser() -> argparse.ArgumentParser:
     au.add_argument("--check", action="store_true",
                     help="exit 1 on any unwaived contract failure")
     au.set_defaults(fn=_cmd_audit)
+
+    sv = sub.add_parser(
+        "serve", help="serving hub: async session admission over a "
+                      "free-running ring engine (swim_tpu/serve)")
+    sv.add_argument("action", choices=("bench",),
+                    help="'bench': the 10^3-client load harness "
+                         "(clean arm vs replay/duplication storm; "
+                         "exit 1 unless the arms stay bitwise-parity)")
+    sv.add_argument("--nodes", type=int, default=1_000_000)
+    sv.add_argument("--sessions", type=int, default=1000)
+    sv.add_argument("--periods", type=int, default=3)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--sockets", type=int, default=16,
+                    help="client UDP sockets the sessions multiplex "
+                         "over (sessions never cost fds)")
+    sv.add_argument("--echo-samples", type=int, default=2000,
+                    help="OP_ECHO RTT probes behind the p50/p99")
+    sv.add_argument("--frontend", choices=("auto", "udppump", "socket"),
+                    default="auto",
+                    help="hub datapath: the udppump epoll frontend "
+                         "when the native toolchain is present")
+    sv.add_argument("--out", default="",
+                    help="write the full result JSON here "
+                         "(bench.py --tier serve owns the committed "
+                         "bench_results/serve_load.json)")
+    sv.add_argument("--json", action="store_true",
+                    help="print the full result (arms included)")
+    sv.set_defaults(fn=_cmd_serve)
     return p
 
 

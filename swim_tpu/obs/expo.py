@@ -247,6 +247,40 @@ def render_memwall(report: dict,
     return "\n".join(lines) + "\n"
 
 
+def render_sessions(report: dict,
+                    labels: dict[str, str] | None = None) -> str:
+    """One serve/hub.py session-stats report as swim_session_* gauges
+    (names pinned in hub.SESSION_GAUGES and linted against this renderer
+    by scripts/check_metrics_registry.py).  Counters and the mirror-byte
+    rate render as plain gauges; per-session clock lag renders one
+    series per attached session with a `session` label (the reserved
+    row id), falling back to the worst lag when the report carries no
+    per-session table — either way the NAME set is exactly
+    SESSION_GAUGES, so the lint and scrape stability hold."""
+    # import-time jax-free: serve/hub.py defers jax to run time
+    from swim_tpu.serve.hub import SESSION_GAUGES, gauge_values
+
+    base = {**(labels or {}),
+            "nodes": str(report.get("nodes", "?"))}
+    lines: list[str] = []
+    values = gauge_values(report)
+    per_session = report.get("sessions") or []
+    for full, help_text in SESSION_GAUGES.items():
+        lines.append(f"# HELP {full} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {full} gauge")
+        if full == "swim_session_clock_lag_periods" and per_session:
+            for s in per_session:
+                lines.append(
+                    f"{full}"
+                    f"{_fmt_labels(base, {'session': str(s.get('row', '?'))})}"
+                    f" {_fmt_float(s.get('clock_lag_periods', 0))}")
+        else:
+            lines.append(f"{full}{_fmt_labels(base)} "
+                         f"{_fmt_float(values[full])}")
+    assert set(values) == set(SESSION_GAUGES)
+    return "\n".join(lines) + "\n"
+
+
 def render_audit(report: dict,
                  labels: dict[str, str] | None = None) -> str:
     """One analysis/audit.py contract report as swim_audit_* gauges

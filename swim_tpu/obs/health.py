@@ -76,6 +76,10 @@ HEALTH_RULES: dict[str, tuple[str, str]] = {
         "error",
         "False-dead views grew while links were flapping (healthy nodes "
         "declared dead by link churn)"),
+    "session_evicted": (
+        "warn",
+        "A bridge/hub session was evicted (disconnect or stall): its "
+        "reserved rows were crash-gated and now die organically"),
 }
 
 # default thresholds; override per-monitor via HealthMonitor(thresholds=)

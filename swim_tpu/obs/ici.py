@@ -40,13 +40,19 @@ V5E_ICI_GBPS = 45.0   # v5e ICI, per link per direction (public figure)
 
 
 def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS,
-                    plan=None) -> dict:
+                    plan=None, ext_capacity: int | None = None) -> dict:
     """Per-chip ICI bytes/period the ShardOps layout moves for `cfg`
     sharded over `d` devices, keyed by collective (trace-derived).
     `plan` defaults to `faults.none` (the baseline bill, unchanged);
     pass a FaultProgram to price its per-wave u16 link lane — the
     `roll_link_thr` term (sim/scenario.py embeds this in verdict
-    artifacts)."""
+    artifacts).  `ext_capacity` prices the serving hub's batched row
+    mirror (swim_tpu/serve/hub.py): the coalesced ExtOriginations batch
+    is ONE placed update per device step — capacity entries of
+    subject/key/origin/hearer at 4 bytes each, replicated to every chip
+    — tallied under the `ext_mirror_rows` term so the auditor's
+    tally-completeness contract covers the hub's mirroring bytes too
+    (with ext_capacity=None the bill is unchanged, like plan)."""
     import jax
     import jax.numpy as jnp
 
@@ -134,9 +140,17 @@ def trace_ici_bytes(cfg, d: int, ici_gbps: float = V5E_ICI_GBPS,
         st = ring.init_state(cfg)
         pl = plan if plan is not None else faults.none(cfg.n_nodes)
         rnd = ring.draw_period_ring(jax.random.key(0), jnp.int32(0), cfg)
-        return ring.step(cfg, st, pl, rnd, ops=ops_c)
+        ext = (None if ext_capacity is None
+               else ring.ext_none(ext_capacity))
+        return ring.step(cfg, st, pl, rnd, ops=ops_c, ext=ext)
 
     jax.eval_shape(one_period)
+    if ext_capacity is not None:
+        # The hub's batched row mirror: one placed ExtOriginations per
+        # device step (4 i32/u32 lanes x capacity), replicated to every
+        # chip — a host->ICI placed update, not a traced collective, so
+        # it is priced here rather than inside CountingOps.
+        add("ext_mirror_rows", 4 * 4 * ext_capacity)
     total = sum(tally.values())
     t_ici_ms = total / (ici_gbps * 1e9) * 1e3
     return {"per_chip_bytes_per_period": total,

@@ -39,6 +39,11 @@ _PPS_SUFFIX = "_periods_per_sec"
 # Same auto-registration (`<tier>_peak_bytes` + `<tier>_nodes`), but the
 # gate direction INVERTS — bytes regress by RISING, p/s by dropping.
 _BYTES_SUFFIX = "_peak_bytes"
+# Serving-hub families (bench.py --tier serve): concurrent sessions
+# sustained (regresses by dropping, like p/s) and p99 round-trip
+# latency in ms (regresses by RISING, inverted like peak_bytes).
+_SESSIONS_SUFFIX = "_sessions"
+_P99_SUFFIX = "_p99_ms"
 
 DEFAULT_THRESHOLD = 0.10
 
@@ -62,6 +67,10 @@ def _samples_from_parsed(parsed: dict, *, source: str, rnd: int | None,
             tier, metric = key[:-len(_PPS_SUFFIX)], "pps"
         elif key.endswith(_BYTES_SUFFIX):
             tier, metric = key[:-len(_BYTES_SUFFIX)], "peak_bytes"
+        elif key.endswith(_P99_SUFFIX):
+            tier, metric = key[:-len(_P99_SUFFIX)], "p99_ms"
+        elif key.endswith(_SESSIONS_SUFFIX):
+            tier, metric = key[:-len(_SESSIONS_SUFFIX)], "sessions"
         else:
             continue
         nodes = parsed.get(f"{tier}_nodes")
@@ -148,7 +157,7 @@ def check(ser: dict[tuple, list[dict]],
         latest, last_good = rounds[-1], rounds[-2]
         drop = 1.0 - latest["pps"] / last_good["pps"] \
             if last_good["pps"] > 0 else 0.0
-        regression = -drop if metric == "peak_bytes" else drop
+        regression = -drop if metric in ("peak_bytes", "p99_ms") else drop
         findings.append({
             "tier": tier, "nodes": nodes, "platform": platform,
             "metric": metric,

@@ -1,0 +1,15 @@
+"""Serving hub: thousands of concurrent external cores on one cluster.
+
+`swim_tpu.serve` is the scale-out sibling of `bridge/engine_server.py`:
+where the bridge locksteps a handful of TCP sessions behind a
+min-over-clocks barrier (one slow client stalls the world), the hub
+(serve/hub.py) runs the ring engine FREE of any client barrier and
+admits/evicts sessions asynchronously over a datagram frontend — the
+udppump epoll datapath when the native toolchain is present, a plain
+Python UDP socket otherwise.  serve/load.py is the 10^3..10^4-client
+load harness behind `swim-tpu serve bench` / `bench.py --tier serve`.
+"""
+
+from swim_tpu.serve.hub import EXT_CAPACITY, SESSION_GAUGES, ServeHub
+
+__all__ = ["EXT_CAPACITY", "SESSION_GAUGES", "ServeHub"]

@@ -1,0 +1,696 @@
+"""Serving hub: async session admission over a free-running ring engine.
+
+`ServeHub` admits thousands of concurrent external cores onto ONE
+tensor-cluster simulation — ROADMAP item 3's "serve heavy traffic"
+half.  Three structural differences from `bridge/engine_server.py`
+(which remains the full-fidelity lockstep seam for a handful of
+sessions):
+
+  NO BARRIER.  The engine steps whenever the driver says so
+    (`step_periods`); no session clock gates it.  A session proves
+    liveness by ACKing its mirrored rotor pings; one that stops
+    (disconnect, stall, wedge) is EVICTED — its reserved row is
+    crash-gated and the cluster detects the death organically — instead
+    of freezing everyone else's time.
+  BOUNDED WORK QUEUE.  Admission (HELLO), clean departure (BYE) and
+    eviction are items on a bounded `queue.Queue` drained by a
+    dedicated worker thread, so the device step NEVER blocks on socket
+    I/O and a join storm degrades to rejections, not latency.
+  BATCHED ROW MIRRORING.  All reserved-row writes for a device step —
+    every session's gossip turned `ring.ExtOriginations` entries — are
+    coalesced into ONE placed update (a single `jax.device_put` of the
+    whole batch) instead of one host->device round-trip per session.
+    The placement is priced as the `ext_mirror_rows` term in
+    obs/ici.py (16 bytes per slot: 4 i32/u32 lanes), and the auditor's
+    `ici_tally_completeness` contract extends over it
+    (analysis/audit.py `placed` family) — which is why `EXT_CAPACITY`
+    lives here as a module constant the auditor imports.
+
+Wire protocol (datagram; native/udppump.cpp epoll frontend when the
+toolchain is present, plain Python UDP otherwise — `frontend="auto"`):
+a fixed `!BII` header (op, a, b) + optional payload.  Sessions are
+keyed by their assigned reserved ROW, not by socket: many sessions
+share one client socket, which is how 10^4 sessions fit under a ~1024
+fd ulimit (serve/load.py multiplexes ~16 sockets).
+
+  HELLO  (c->h)  a=client nonce          -> WELCOME a=row b=nonce
+                                          | REJECT a=reason b=nonce
+  BYE    (c->h)  a=row                   clean leave: row returns to
+                                         the free pool, NO plan
+                                         mutation (churn-neutral)
+  DGRAM  (c->h)  a=src row b=dst node    payload = core/codec.py bytes
+                                         (gossip -> injections; ACK ->
+                                         liveness credit; PING -> D3
+                                         synthesized ack)
+  DELIVER(h->c)  a=sender b=dst row      payload = codec bytes
+                                         (mirrored rotor pings, acks)
+  ECHO   (c->h)  a,b opaque              -> ECHO_REPLY a,b — answered
+                                         straight from the frontend
+                                         drain, the RTT probe the load
+                                         harness p50/p99 is built on
+Deviations D2/D3 are inherited from engine_server.py where the shared
+seam applies; hub-synthesized acks carry EMPTY gossip unless
+`mirror_gossip=True` (the full resolved-row diff per session per
+period is the lockstep bridge's fidelity trade, not the hub's).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import queue
+import socket
+import struct
+import threading
+
+import numpy as np
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.core import codec
+from swim_tpu.obs.health import Finding
+from swim_tpu.types import MsgKind, Status, key_incarnation, key_status, \
+    opinion_key
+
+WORD = 32
+
+# Static capacity of the coalesced per-step ExtOriginations placement.
+# analysis/audit.py imports this to price the hub's mirroring bytes
+# (ici_tally_completeness / serve_ext_mirror: exactly 16 bytes per slot).
+EXT_CAPACITY = 64
+
+# ------------------------------------------------------------ wire format
+
+HDR = struct.Struct("!BII")
+
+OP_HELLO = 1
+OP_BYE = 2
+OP_DGRAM = 3
+OP_WELCOME = 4
+OP_DELIVER = 5
+OP_ECHO = 6
+OP_ECHO_REPLY = 7
+OP_REJECT = 8
+
+REJ_FULL = 1        # no free reserved row
+REJ_QUEUE = 2       # admission queue full (join storm back-pressure)
+
+
+def pack(op: int, a: int = 0, b: int = 0, payload: bytes = b"") -> bytes:
+    return HDR.pack(op, a & 0xFFFFFFFF, b & 0xFFFFFFFF) + payload
+
+
+def unpack(data: bytes) -> tuple[int, int, int, bytes]:
+    op, a, b = HDR.unpack_from(data, 0)
+    return op, a, b, data[HDR.size:]
+
+
+# --------------------------------------------------------- gauge surface
+
+SESSION_GAUGES: dict[str, str] = {
+    "swim_session_admitted":
+        "Sessions admitted onto reserved rows since hub start",
+    "swim_session_evicted":
+        "Sessions evicted (stall/disconnect; their rows were "
+        "crash-gated and die organically)",
+    "swim_session_active":
+        "Sessions currently attached to reserved rows",
+    "swim_session_clock_lag_periods":
+        "Periods since a session's last liveness credit (per-session "
+        "series when the report carries a session table)",
+    "swim_session_mirror_bytes_per_period":
+        "Bytes of the coalesced per-step ExtOriginations placement "
+        "(the obs/ici.py ext_mirror_rows term: 16 per slot)",
+}
+
+
+def gauge_values(report: dict) -> dict[str, float]:
+    """SESSION_GAUGES values from one `ServeHub.report()` dict (the
+    expo.render_sessions scalar fallback; clock lag collapses to the
+    WORST attached session)."""
+    sessions = report.get("sessions") or []
+    worst = max((float(s.get("clock_lag_periods", 0)) for s in sessions),
+                default=0.0)
+    return {
+        "swim_session_admitted": float(report.get("admitted", 0)),
+        "swim_session_evicted": float(report.get("evicted", 0)),
+        "swim_session_active": float(report.get("active", 0)),
+        "swim_session_clock_lag_periods": worst,
+        "swim_session_mirror_bytes_per_period":
+            float(report.get("mirror_bytes_per_period", 0)),
+    }
+
+
+# ------------------------------------------------------------- frontends
+
+
+class _SocketFrontend:
+    """Plain Python UDP frontend (the no-toolchain fallback): one
+    socket, one drain thread, same callback contract as the pump."""
+
+    kind = "socket"
+
+    def __init__(self, host: str, port: int, on_datagram):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, port))
+        self._sock.settimeout(0.25)
+        self.local_address = self._sock.getsockname()
+        self._on = on_datagram
+        self._closing = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        while not self._closing:
+            try:
+                data, addr = self._sock.recvfrom(65535)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                self._on(addr, data)
+            except Exception:  # noqa: BLE001 — a broken handler must not
+                pass           # kill the drain loop (pump contract)
+
+    def send(self, to, payload: bytes) -> None:
+        try:
+            self._sock.sendto(payload, to)
+        except OSError:
+            pass               # datagram loss is legal on this seam
+
+    def stats(self) -> dict[str, int]:
+        return {}
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5.0)
+
+
+class _PumpFrontend:
+    """The udppump epoll datapath as hub frontend: sends enqueue into
+    the pump's outbox, inbound datagrams arrive in batches on the
+    drainer thread — one GIL crossing per batch, which is what makes
+    10^3 concurrent clients cheap (native/udppump.cpp)."""
+
+    kind = "udppump"
+
+    def __init__(self, host: str, port: int, on_datagram):
+        from swim_tpu.native.transport import NativeUDPTransport
+
+        self._t = NativeUDPTransport(host, port)
+        self._t.set_receiver(on_datagram)
+        self.local_address = self._t.local_address
+
+    def send(self, to, payload: bytes) -> None:
+        self._t.send(to, payload)
+
+    def stats(self) -> dict[str, int]:
+        return self._t.stats()
+
+    def close(self) -> None:
+        self._t.close()
+
+
+def make_frontend(host: str, port: int, on_datagram, prefer: str = "auto"):
+    """The hub datapath: `"udppump"` (native epoll, raises without the
+    toolchain), `"socket"` (pure Python), or `"auto"` (pump when
+    available — the promoted default)."""
+    if prefer not in ("auto", "udppump", "socket"):
+        raise ValueError(f"bad frontend {prefer!r}")
+    if prefer in ("auto", "udppump"):
+        from swim_tpu.native import transport as native_transport
+
+        if native_transport.is_available():
+            return _PumpFrontend(host, port, on_datagram)
+        if prefer == "udppump":
+            raise RuntimeError("native udppump unavailable (no toolchain)")
+    return _SocketFrontend(host, port, on_datagram)
+
+
+# ------------------------------------------------------------------- hub
+
+
+class _Client:
+    """One admitted session: a reserved row plus its return address."""
+
+    __slots__ = ("row", "addr", "joined_t", "last_ack_t", "pings_sent",
+                 "pings_acked")
+
+    def __init__(self, row: int, addr, t: int):
+        self.row = row
+        self.addr = addr            # None: in-process attach (no sends)
+        self.joined_t = t
+        self.last_ack_t = t
+        self.pings_sent = 0
+        self.pings_acked = 0
+
+
+class ServeHub:
+    """Async-admission serving hub over one ring-engine simulation.
+
+    `reserved_rows` are the engine node ids sessions may attach to;
+    admission assigns a free one without retracing (the jitted step is
+    shape-stable: the plan and the fixed-capacity ExtOriginations batch
+    are the only inputs that change).  Drive the engine with
+    `step_periods(k)` (deterministic — tests and the load harness) or
+    `start(auto_period=s)` (free-running).  `attach()`/`detach()` are
+    the in-process admission path (same worker-queue internals, no
+    sockets) used by the churn-neutrality test.
+    """
+
+    def __init__(self, cfg: SwimConfig, reserved_rows: list[int],
+                 seed: int = 0, host: str = "127.0.0.1", port: int = 0,
+                 ext_capacity: int = EXT_CAPACITY, ack_grace: int = 3,
+                 queue_capacity: int = 1024, frontend: str = "auto",
+                 mirror_gossip: bool = False):
+        import jax
+
+        from swim_tpu.models import ring
+
+        if cfg.ring_probe != "rotor":
+            raise ValueError("ServeHub requires the rotor probe (the "
+                             "mirrored-ping seam is rotor-shaped)")
+        self.cfg = cfg
+        self.n = cfg.n_nodes
+        rows = list(reserved_rows)
+        if len(set(rows)) != len(rows):
+            raise ValueError("duplicate reserved rows")
+        for r in rows:
+            if not 0 <= r < self.n:
+                raise ValueError("reserved rows must be node ids")
+        self.reserved_rows = rows
+        self.ext_capacity = int(ext_capacity)
+        self.ack_grace = int(ack_grace)
+        self.mirror_gossip = bool(mirror_gossip)
+        self._jax = jax
+        self._ring = ring
+        self._key = jax.random.key(seed)
+        self.state = ring.init_state(cfg)
+        self.t = 0
+        self._step = jax.jit(functools.partial(ring.step, cfg))
+        self._ext_empty = ring.ext_none(self.ext_capacity)  # device-resident
+        # host-side fault mirrors (device plan rebuilt on change; the
+        # engine_server.py generation-checked pattern)
+        self._crash = np.full((self.n,), np.iinfo(np.int32).max // 2,
+                              np.int32)
+        self._join = np.zeros((self.n,), np.int32)
+        self._plan = None
+        self._plan_dirty = True
+        self._plan_gen = 0
+        self._inject: list[tuple[int, int, int, int]] = []
+        self._lock = threading.Lock()
+        # bounded work queue: the ONLY path from socket I/O to hub
+        # membership state; the device step never waits on it
+        self._work: queue.Queue = queue.Queue(maxsize=queue_capacity)
+        self._free: collections.deque[int] = collections.deque(rows)
+        self._clients: dict[int, _Client] = {}
+        self._findings: list[Finding] = []
+        self._stats = {"admitted": 0, "evicted": 0, "left": 0,
+                       "rejected_full": 0, "queue_drops": 0,
+                       "mirror_updates": 0, "mirror_bytes": 0,
+                       "datagrams": 0, "echoes": 0}
+        if self.mirror_gossip:
+            self._subject = np.asarray(self.state.subject)
+            self._rkey = np.asarray(self.state.rkey)
+            self._prev_rows: dict[int, np.ndarray] = {}
+        self._closing = False
+        self.frontend = make_frontend(host, port, self._on_datagram,
+                                      frontend)
+        self.address = self.frontend.local_address
+        self._worker = threading.Thread(target=self._admission_worker,
+                                        daemon=True)
+        self._worker.start()
+        self._engine_thread: threading.Thread | None = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self, auto_period: float = 0.05) -> None:
+        """Free-running mode: step one period every `auto_period`
+        seconds until close().  Admission/datapath threads run either
+        way; tests and the harness prefer step_periods()."""
+        def loop() -> None:
+            import time
+
+            while not self._closing:
+                self._period()
+                time.sleep(auto_period)
+
+        self._engine_thread = threading.Thread(target=loop, daemon=True)
+        self._engine_thread.start()
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._work.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=10)
+        self._worker.join(timeout=10)
+        self.frontend.close()
+
+    # ------------------------------------------------------ admission path
+
+    def _on_datagram(self, addr, data: bytes) -> None:
+        """Frontend drain callback — pump or socket thread.  Never
+        touches device state and never blocks: membership changes go
+        through the bounded queue, everything else reads host mirrors."""
+        if len(data) < HDR.size:
+            return
+        op, a, b, payload = unpack(data)
+        if op == OP_ECHO:
+            # answered straight from the drain: the load harness's RTT
+            # probe measures the datapath, not the engine
+            with self._lock:
+                self._stats["echoes"] += 1
+            self.frontend.send(addr, pack(OP_ECHO_REPLY, a, b))
+        elif op == OP_HELLO:
+            try:
+                self._work.put_nowait(("admit", addr, a))
+            except queue.Full:
+                with self._lock:
+                    self._stats["queue_drops"] += 1
+                self.frontend.send(addr, pack(OP_REJECT, REJ_QUEUE, a))
+        elif op == OP_BYE:
+            try:
+                self._work.put_nowait(("leave", a, addr))
+            except queue.Full:
+                with self._lock:     # client may re-send; worst case the
+                    self._stats["queue_drops"] += 1   # row stalls out
+        elif op == OP_DGRAM:
+            self._on_session_datagram(addr, a, b, payload)
+
+    def _admission_worker(self) -> None:
+        """Drains the bounded work queue: admissions, clean leaves,
+        evictions.  A dedicated thread, so admission latency is set by
+        queue depth — not by the device step."""
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            try:
+                kind = item[0]
+                if kind == "admit":
+                    self._do_admit(item[1], item[2])
+                elif kind == "leave":
+                    self._do_leave(item[1], item[2])
+                elif kind == "evict":
+                    self._do_evict(item[1], item[2])
+            except Exception:  # noqa: BLE001 — one bad item must not
+                pass           # kill the admission plane
+
+    def _do_admit(self, addr, nonce: int) -> None:
+        with self._lock:
+            row = self._free.popleft() if self._free else None
+            if row is not None:
+                self._clients[row] = _Client(row, addr, self.t)
+                self._stats["admitted"] += 1
+            else:
+                self._stats["rejected_full"] += 1
+        if addr is None:
+            return
+        if row is None:
+            self.frontend.send(addr, pack(OP_REJECT, REJ_FULL, nonce))
+        else:
+            self.frontend.send(addr, pack(OP_WELCOME, row, nonce))
+
+    def _do_leave(self, row: int, addr) -> None:
+        """Clean departure: the row returns to the free pool with NO
+        plan mutation — tensor state is untouched, which is what makes
+        silent join/leave churn bitwise-neutral (tests/test_serve.py)."""
+        with self._lock:
+            c = self._clients.get(row)
+            if c is None or (addr is not None and c.addr != addr):
+                return
+            del self._clients[row]
+            self._free.append(row)
+            self._stats["left"] += 1
+
+    def _do_evict(self, row: int, reason: str) -> None:
+        with self._lock:
+            c = self._clients.pop(row, None)
+            if c is None:
+                return
+            self._stats["evicted"] += 1
+            lag = self.t - c.last_ack_t
+            self._findings.append(Finding(
+                rule="session_evicted", severity="warn", period=self.t,
+                value=float(lag), threshold=float(self.ack_grace),
+                message=f"session row {row} evicted ({reason}): "
+                        f"{lag} periods without liveness credit"))
+        # row is NOT returned to the pool: it is crash-gated and the
+        # cluster detects the death organically (kill takes _lock)
+        self.kill(row)
+
+    # in-process admission (no sockets): the churn test's deterministic
+    # path through the SAME worker internals
+
+    def attach(self) -> int | None:
+        """Synchronously admit an in-process session; returns its row
+        (None when the pool is exhausted)."""
+        with self._lock:
+            before = set(self._clients)
+        self._do_admit(None, 0)
+        with self._lock:
+            new = set(self._clients) - before
+        return new.pop() if new else None
+
+    def detach(self, row: int) -> None:
+        """Synchronously leave (clean): the in-process BYE."""
+        self._do_leave(row, None)
+
+    def evict(self, row: int, reason: str = "test") -> None:
+        """Synchronously evict: crash-gate the row + health finding."""
+        self._do_evict(row, reason)
+
+    # ------------------------------------------------------- fault wiring
+
+    def kill(self, node_id: int) -> None:
+        with self._lock:
+            if 0 <= node_id < self.n and self._crash[node_id] > self.t:
+                self._crash[node_id] = self.t
+                self._plan_dirty = True
+                self._plan_gen += 1
+
+    def _alive(self, node_id: int) -> bool:
+        return (0 <= node_id < self.n and self._crash[node_id] > self.t
+                and self._join[node_id] <= self.t)
+
+    def _device_plan(self):
+        with self._lock:
+            rebuild = self._plan_dirty or self._plan is None
+            gen = self._plan_gen
+            if rebuild:
+                crash = self._crash.copy()
+                join = self._join.copy()
+        if rebuild:
+            import jax.numpy as jnp
+
+            from swim_tpu.sim.faults import FaultPlan
+
+            self._plan = FaultPlan(
+                crash_step=jnp.asarray(crash),
+                loss=jnp.float32(0.0),
+                partition_id=jnp.zeros((self.n,), jnp.uint8),
+                partition_start=jnp.int32(1 << 30),
+                partition_end=jnp.int32(1 << 30),
+                join_step=jnp.asarray(join))
+            with self._lock:
+                if self._plan_gen == gen:
+                    self._plan_dirty = False
+        return self._plan
+
+    # ------------------------------------------------------- session seam
+
+    def _on_session_datagram(self, addr, src: int, dst: int,
+                             payload: bytes) -> None:
+        """One DGRAM from session row `src` toward engine node `dst`
+        (codec bytes).  Runs on the frontend thread; reads host mirrors
+        only — the engine may be mid-step on another thread."""
+        with self._lock:
+            c = self._clients.get(src)
+            if c is None or (c.addr is not None and c.addr != addr):
+                return
+            self._stats["datagrams"] += 1
+        try:
+            kind = codec.peek_kind(payload)
+        except codec.DecodeError:
+            return
+        if kind == MsgKind.ACK:
+            with self._lock:
+                c.pings_acked = c.pings_sent
+                c.last_ack_t = self.t
+            return
+        try:
+            msg = codec.decode(payload)
+        except codec.DecodeError:
+            return
+        self._queue_injections(dst if self._alive(dst) else src,
+                               msg.gossip)
+        if kind == MsgKind.PING and self._alive(dst):
+            # D3: answer from host state at datagram time (empty gossip
+            # unless mirror_gossip — the hub trades the lockstep
+            # bridge's piggyback fidelity for datapath throughput)
+            ack = codec.Message(kind=MsgKind.ACK, sender=dst,
+                                probe_seq=msg.probe_seq,
+                                on_behalf=msg.on_behalf)
+            self._deliver(src, dst, ack)
+
+    def _queue_injections(self, hearer: int,
+                          gossip: tuple[codec.WireUpdate, ...]) -> None:
+        for u in gossip:
+            if not 0 <= u.member < self.n:
+                continue
+            key = opinion_key(int(u.status), u.incarnation)
+            if self.mirror_gossip and key <= self._best_key(u.member):
+                continue             # stale vs table mirror (D2)
+            org = u.origin if 0 <= u.origin < self.n else hearer
+            with self._lock:
+                self._inject.append((u.member, key, org, hearer))
+
+    def _deliver(self, row: int, sender: int, msg: codec.Message) -> None:
+        with self._lock:
+            c = self._clients.get(row)
+            addr = c.addr if c is not None else None
+        if addr is not None:
+            self.frontend.send(addr, pack(OP_DELIVER, sender, row,
+                                          codec.encode(msg)))
+
+    # ------------------------------------------------------------- engine
+
+    def step_periods(self, k: int) -> None:
+        for _ in range(k):
+            self._period()
+
+    def _period(self) -> None:
+        import jax
+
+        ring = self._ring
+        # 1. eviction scan — a session that missed its last ack_grace
+        # mirrored pings is enqueued for eviction (never evicted inline:
+        # membership changes stay on the worker thread)
+        with self._lock:
+            stale = [c.row for c in self._clients.values()
+                     if c.pings_sent - c.pings_acked > self.ack_grace]
+        for row in stale:
+            try:
+                self._work.put_nowait(("evict", row, "stall"))
+            except queue.Full:
+                break                # retry next period
+        # 2. the batched row mirror: coalesce every queued reserved-row
+        # write into ONE placed ExtOriginations (a single device_put of
+        # the whole fixed-capacity batch — the ext_mirror_rows bytes)
+        with self._lock:
+            batch = self._inject[:self.ext_capacity]
+            self._inject = self._inject[self.ext_capacity:]
+        if batch:
+            cap = self.ext_capacity
+            subject = np.full((cap,), -1, np.int32)
+            key = np.zeros((cap,), np.uint32)
+            origin = np.zeros((cap,), np.int32)
+            hearer = np.zeros((cap,), np.int32)
+            for i, (s, k, o, h) in enumerate(batch):
+                subject[i], key[i], origin[i], hearer[i] = s, k, o, h
+            ext = jax.device_put(ring.ExtOriginations(
+                subject=subject, key=key, origin=origin, hearer=hearer))
+            with self._lock:
+                self._stats["mirror_updates"] += 1
+                self._stats["mirror_bytes"] += 16 * cap
+        else:
+            ext = self._ext_empty    # cached device-resident empty batch
+        # 3. one engine period (shape-stable: no retrace on churn)
+        rnd = ring.draw_period_ring(self._key, self.t, self.cfg)
+        self.state = self._step(self.state, self._device_plan(), rnd,
+                                ext=ext)
+        s_off = int(jax.device_get(rnd.s_off))
+        self.t += 1
+        # 4. mirror the rotor probe of every attached session
+        if self.mirror_gossip:
+            self._subject = np.asarray(self.state.subject)
+            self._rkey = np.asarray(self.state.rkey)
+        with self._lock:
+            attached = list(self._clients.values())
+        for c in attached:
+            prober = (c.row - s_off) % self.n
+            if not self._alive(prober):
+                continue             # no probe of this row this period
+            gossip: tuple = ()
+            if self.mirror_gossip:
+                gossip = self._fresh_updates(c.row, prober)
+            with self._lock:
+                c.pings_sent += 1
+            self._deliver(c.row, prober, codec.Message(
+                kind=MsgKind.PING, sender=prober, probe_seq=self.t,
+                gossip=gossip))
+
+    # ------------------------------------------------- state decoding
+    # (host mirrors; the engine_server.py shapes, used only with
+    # mirror_gossip=True)
+
+    def _best_key(self, member: int) -> int:
+        mask = self._subject == member
+        return int(self._rkey[mask].max()) if mask.any() else 0
+
+    def _resolved_row(self, x: int) -> np.ndarray:
+        g = self._ring.geometry(self.cfg)
+        win_x = np.asarray(self.state.win[x])
+        cold_x = np.asarray(self.state.cold[:, x])
+        t = int(self.state.step)
+        first_gw = t * g.ow - g.ww
+        win_ring0 = first_gw % g.rw
+        words = cold_x.copy()
+        for w in range(g.ww):
+            words[(win_ring0 + w) % g.rw] = win_x[w]
+        return np.unpackbits(words.astype("<u4").view(np.uint8),
+                             bitorder="little").astype(bool)
+
+    def _fresh_updates(self, row: int,
+                       origin: int) -> tuple[codec.WireUpdate, ...]:
+        cur = self._resolved_row(row)
+        prev = self._prev_rows.get(row)
+        self._prev_rows[row] = cur
+        fresh = cur if prev is None else (cur & ~prev)
+        out = []
+        for sl in np.nonzero(fresh)[0].tolist()[:255]:
+            subj = int(self._subject[sl])
+            if subj < 0:
+                continue
+            k = int(self._rkey[sl])
+            out.append(codec.WireUpdate(
+                member=subj, status=Status(key_status(k)),
+                incarnation=key_incarnation(k), addr=("sim", subj),
+                origin=origin))
+        return tuple(out)
+
+    # ------------------------------------------------------------ reports
+
+    def findings(self) -> list[Finding]:
+        with self._lock:
+            return list(self._findings)
+
+    def report(self) -> dict:
+        """Point-in-time session stats — the expo.render_sessions /
+        SESSION_GAUGES input."""
+        with self._lock:
+            sessions = [{"row": c.row,
+                         "clock_lag_periods": self.t - c.last_ack_t}
+                        for c in self._clients.values()]
+            return {"nodes": self.n,
+                    "periods": self.t,
+                    "frontend": self.frontend.kind,
+                    "admitted": self._stats["admitted"],
+                    "evicted": self._stats["evicted"],
+                    "left": self._stats["left"],
+                    "active": len(self._clients),
+                    "rejected_full": self._stats["rejected_full"],
+                    "queue_drops": self._stats["queue_drops"],
+                    "mirror_updates": self._stats["mirror_updates"],
+                    "mirror_bytes": self._stats["mirror_bytes"],
+                    "mirror_bytes_per_period": 16 * self.ext_capacity,
+                    "datagrams": self._stats["datagrams"],
+                    "echoes": self._stats["echoes"],
+                    "sessions": sessions}

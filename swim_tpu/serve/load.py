@@ -1,0 +1,311 @@
+"""Load harness: 10^3..10^4 concurrent bridge clients against one hub.
+
+`run_load` is the engine behind `swim-tpu serve bench` / `bench.py
+--tier serve`.  It stands up a `ServeHub` over a >=1M-node ring engine
+(LEAN-anchor geometry, the telemetry-tier shape) and drives SESSIONS
+concurrent clients at it from this host, multiplexed over a handful of
+shared UDP sockets — 10^4 sessions never means 10^4 fds; the hub keys
+sessions by reserved row, not by socket.  Defended metrics:
+
+  sessions/sec   admission rate: HELLO burst start -> last WELCOME
+                 (with datagram retry, so a dropped reply costs latency
+                 rather than a lost session)
+  p50/p99 ms     round-trip latency of OP_ECHO probes answered straight
+                 from the hub's frontend drain, sampled WHILE the
+                 engine steps and every session ACKs its mirrored pings
+
+Two arms, same seed and geometry, run back to back (the
+tests/test_ring_shard.py tri-run spirit applied to the serving seam):
+
+  clean   admission burst + echo sampling + per-period mirrored-ping
+          ACKs from every session
+  storm   identical, plus the sim/scenario.py replay_storm adversary
+          applied to every session datagram (`duplicate`/`replay`
+          knobs, the real-node SimNetwork vocabulary): a fraction of
+          client->hub datagrams is sent twice, a fraction re-sends a
+          stale earlier payload
+
+`ok_parity` asserts the two arms leave the engine state BITWISE
+identical (sha256 over every state field) and that both admitted the
+full session count: adversarial datapath traffic — duplicated acks,
+replayed probes, echo floods — must never perturb the tensor verdict.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+import time
+
+import numpy as np
+
+from swim_tpu.config import SwimConfig
+from swim_tpu.core import codec
+from swim_tpu.serve import hub as hub_mod
+from swim_tpu.serve.hub import (HDR, OP_BYE, OP_DELIVER, OP_DGRAM, OP_ECHO,
+                                OP_ECHO_REPLY, OP_HELLO, OP_REJECT,
+                                OP_WELCOME, ServeHub, pack, unpack)
+from swim_tpu.types import MsgKind
+
+# The 1M-capable geometry the telemetry tier anchors on (bench.py
+# LEAN_ANCHOR): small window, period-scoped selection — the shape that
+# fits a million-node ring state on the CPU host.
+SERVE_ANCHOR = {"ring_sel_scope": "period", "suspicion_mult": 2.0,
+                "retransmit_mult": 2.0, "k_indirect": 1,
+                "ring_window_periods": 3, "ring_view_c": 2}
+
+DEFAULT_STORM = {"duplicate": 0.3, "replay": 0.3}
+
+
+def state_digest(state) -> str:
+    """sha256 over every ring-state field (bitwise arm comparator)."""
+    h = hashlib.sha256()
+    for name, arr in zip(state._fields, state):
+        h.update(name.encode())
+        h.update(np.asarray(arr).tobytes())
+    return h.hexdigest()
+
+
+class _ClientArm:
+    """SESSIONS concurrent clients over `n_sockets` shared UDP sockets.
+
+    Each socket owns sessions round-robin and runs one receiver thread:
+    WELCOME completes an admission, DELIVERed mirrored pings are ACKed
+    back through the session seam, ECHO_REPLY closes an RTT sample.
+    The storm knobs wrap every session datagram (DGRAM/ECHO) — never
+    HELLO/BYE, mirroring replay_storm's scope: adversarial *session
+    traffic*, not adversarial membership."""
+
+    def __init__(self, hub_addr, sessions: int, n_sockets: int = 16,
+                 duplicate: float = 0.0, replay: float = 0.0,
+                 seed: int = 0):
+        self.hub_addr = hub_addr
+        self.sessions = sessions
+        self.duplicate = duplicate
+        self.replay = replay
+        self._rng = np.random.default_rng(seed * 6151 + 13)
+        self._socks = []
+        for _ in range(min(n_sockets, sessions)):
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.bind(("127.0.0.1", 0))
+            s.settimeout(0.25)
+            self._socks.append(s)
+        self._lock = threading.Lock()
+        self.row_of: dict[int, int] = {}       # nonce -> assigned row
+        self.rejected: dict[int, int] = {}     # nonce -> reason
+        self.last_welcome = 0.0
+        self._echo_sent: dict[int, float] = {}
+        self.rtts_ms: list[float] = []
+        self.acks_sent = 0
+        self._history: list[tuple[socket.socket, bytes]] = []
+        self._closing = False
+        self._threads = [threading.Thread(target=self._recv_loop,
+                                          args=(s,), daemon=True)
+                         for s in self._socks]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------- sends
+
+    def _send(self, sock: socket.socket, data: bytes) -> None:
+        """One session datagram, through the adversary: maybe
+        duplicated, maybe followed by a stale replay from history."""
+        sock.sendto(data, self.hub_addr)
+        if self.duplicate > 0.0 and self._rng.random() < self.duplicate:
+            sock.sendto(data, self.hub_addr)
+        if self.replay > 0.0:
+            with self._lock:
+                self._history.append((sock, data))
+                if len(self._history) > 4096:
+                    del self._history[:2048]
+                stale = (self._history[
+                    int(self._rng.integers(len(self._history)))]
+                    if self._rng.random() < self.replay else None)
+            if stale is not None:
+                stale[0].sendto(stale[1], self.hub_addr)
+
+    # ---------------------------------------------------------- admission
+
+    def admit_all(self, timeout: float = 60.0) -> dict:
+        """HELLO every session (nonce = session index) and wait for the
+        WELCOMEs; unanswered nonces are re-sent every 200ms.  Returns
+        the admission metrics."""
+        start = time.monotonic()
+        deadline = start + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                missing = [i for i in range(self.sessions)
+                           if i not in self.row_of
+                           and i not in self.rejected]
+            if not missing:
+                break
+            for i in missing:
+                sock = self._socks[i % len(self._socks)]
+                sock.sendto(pack(OP_HELLO, i, 0), self.hub_addr)
+            time.sleep(0.2)
+        with self._lock:
+            admitted = len(self.row_of)
+            end = self.last_welcome or time.monotonic()
+        seconds = max(end - start, 1e-9)
+        return {"sessions": admitted,
+                "rejected": len(self.rejected),
+                "seconds": round(seconds, 4),
+                "sessions_per_sec": round(admitted / seconds, 1)}
+
+    def leave_all(self) -> None:
+        with self._lock:
+            rows = list(self.row_of.items())
+        for nonce, row in rows:
+            sock = self._socks[nonce % len(self._socks)]
+            sock.sendto(pack(OP_BYE, row, 0), self.hub_addr)
+
+    # -------------------------------------------------------------- echo
+
+    def sample_echoes(self, samples: int, spacing_s: float = 0.001,
+                      settle_s: float = 1.0) -> None:
+        for seq in range(samples):
+            sock = self._socks[seq % len(self._socks)]
+            with self._lock:
+                self._echo_sent[seq] = time.monotonic()
+            self._send(sock, pack(OP_ECHO, seq, 0))
+            time.sleep(spacing_s)
+        deadline = time.monotonic() + settle_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._echo_sent:
+                    return
+            time.sleep(0.02)
+
+    # ------------------------------------------------------------ receive
+
+    def _recv_loop(self, sock: socket.socket) -> None:
+        while not self._closing:
+            try:
+                data, _ = sock.recvfrom(65535)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if len(data) < HDR.size:
+                continue
+            op, a, b, payload = unpack(data)
+            if op == OP_WELCOME:
+                with self._lock:
+                    if b not in self.row_of:
+                        self.row_of[b] = a
+                        self.last_welcome = time.monotonic()
+            elif op == OP_REJECT:
+                with self._lock:
+                    # queue-full rejects retry (transient back-pressure);
+                    # pool-full rejects are terminal for the nonce
+                    if a == hub_mod.REJ_FULL:
+                        self.rejected[b] = a
+            elif op == OP_ECHO_REPLY:
+                now = time.monotonic()
+                with self._lock:
+                    sent = self._echo_sent.pop(a, None)
+                    if sent is not None:
+                        self.rtts_ms.append((now - sent) * 1e3)
+            elif op == OP_DELIVER:
+                # a mirrored rotor ping for row b: ACK it back through
+                # the session seam (the hub's liveness credit)
+                try:
+                    if codec.peek_kind(payload) != MsgKind.PING:
+                        continue
+                    msg = codec.decode(payload)
+                except codec.DecodeError:
+                    continue
+                ack = codec.encode(codec.Message(
+                    kind=MsgKind.ACK, sender=b, probe_seq=msg.probe_seq))
+                self._send(sock, pack(OP_DGRAM, b, a, ack))
+                with self._lock:
+                    self.acks_sent += 1
+
+    def close(self) -> None:
+        self._closing = True
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+
+def _percentile(vals: list[float], q: float) -> float:
+    if not vals:
+        return 0.0
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+def _run_arm(cfg: SwimConfig, sessions: int, periods: int, seed: int,
+             n_sockets: int, echo_samples: int, frontend: str,
+             duplicate: float, replay: float) -> dict:
+    hub = ServeHub(cfg, reserved_rows=list(range(sessions)), seed=seed,
+                   ext_capacity=hub_mod.EXT_CAPACITY,
+                   # no evictions during the measured run: every arm
+                   # must leave the plan untouched for bitwise parity
+                   ack_grace=periods + 2,
+                   queue_capacity=max(1024, sessions + 128),
+                   frontend=frontend)
+    arm = _ClientArm(hub.address, sessions, n_sockets=n_sockets,
+                     duplicate=duplicate, replay=replay, seed=seed)
+    try:
+        admission = arm.admit_all()
+        echo_thread = threading.Thread(
+            target=arm.sample_echoes, args=(echo_samples,), daemon=True)
+        step_s = time.monotonic()
+        echo_thread.start()
+        hub.step_periods(periods)
+        step_seconds = time.monotonic() - step_s
+        echo_thread.join(timeout=120.0)
+        time.sleep(0.3)              # let in-flight ACKs drain
+        digest = state_digest(hub.state)
+        report = hub.report()
+        return {"admission": admission,
+                "rtt_ms": {"p50": round(_percentile(arm.rtts_ms, 50), 3),
+                           "p99": round(_percentile(arm.rtts_ms, 99), 3),
+                           "samples": len(arm.rtts_ms)},
+                "acks_sent": arm.acks_sent,
+                "step_seconds": round(step_seconds, 3),
+                "digest": digest,
+                "report": report}
+    finally:
+        arm.close()
+        hub.close()
+
+
+def run_load(n_nodes: int = 1_000_000, sessions: int = 1000,
+             periods: int = 3, seed: int = 0, n_sockets: int = 16,
+             echo_samples: int = 2000, frontend: str = "auto",
+             storm: dict | None = None) -> dict:
+    """The full serve-tier measurement: clean arm, storm arm, parity.
+
+    Returns the bench_results/serve_load.json payload (bench.py stamps
+    captured_at/commit).  `ok_parity` is the defended invariant: the
+    adversarial arm's duplicated/replayed session traffic leaves engine
+    state bitwise identical AND both arms admit every session."""
+    storm = dict(DEFAULT_STORM if storm is None else storm)
+    cfg = SwimConfig(n_nodes=n_nodes, **SERVE_ANCHOR)
+    clean = _run_arm(cfg, sessions, periods, seed, n_sockets,
+                     echo_samples, frontend, 0.0, 0.0)
+    stormed = _run_arm(cfg, sessions, periods, seed, n_sockets,
+                       echo_samples, frontend,
+                       float(storm.get("duplicate", 0.0)),
+                       float(storm.get("replay", 0.0)))
+    ok = (clean["digest"] == stormed["digest"]
+          and clean["admission"]["sessions"] == sessions
+          and stormed["admission"]["sessions"] == sessions)
+    return {"nodes": n_nodes,
+            "sessions": sessions,
+            "periods": periods,
+            "frontend": clean["report"]["frontend"],
+            "anchor_cfg": dict(SERVE_ANCHOR),
+            "admission_sessions_per_sec":
+                clean["admission"]["sessions_per_sec"],
+            "p50_rtt_ms": clean["rtt_ms"]["p50"],
+            "p99_rtt_ms": clean["rtt_ms"]["p99"],
+            "clean": clean,
+            "storm": {"knobs": storm, **stormed},
+            "ok_parity": ok}

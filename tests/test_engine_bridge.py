@@ -321,6 +321,16 @@ class TestStalledSession:
                 f"stalled core not confirmed: "
                 f"{[hex(k) for k in server.table_keys(xa)]}")
             assert not server._ext_crashed[xb]
+            # the eviction surfaced on the health trail (regression:
+            # the old semantics evicted silently) — a session_evicted
+            # warn Finding naming the stalled id, never the healthy one
+            evs = [f for f in server.findings
+                   if f.rule == "session_evicted"]
+            assert len(evs) == 1, server.findings
+            assert evs[0].severity == "warn"
+            assert f"external id {xa}" in evs[0].message
+            assert evs[0].value > evs[0].threshold == float(
+                server.ack_grace)
             bp.write_frame(sb, bp.Frame(bp.BYE))
         finally:
             sa.close()

@@ -1,0 +1,343 @@
+"""Serving hub (swim_tpu/serve): admission, eviction, churn parity.
+
+Proof obligations for the async serving seam:
+  * admission over real datagrams: HELLO -> WELCOME with the nonce
+    echoed, BYE returns the row to the pool, an exhausted pool answers
+    REJECT(full), a full work queue answers REJECT(queue) — the
+    bounded-queue back-pressure contract (join storms degrade to
+    rejections, never to device-step latency),
+  * eviction: a session that stops ACKing its mirrored pings is evicted
+    after `ack_grace` periods — its row crash-gated (NOT recycled) and
+    a `session_evicted` warn Finding appended to the health trail,
+  * churn neutrality (the tests/test_ring_shard.py tri-run pattern
+    applied to the serving seam): a join/leave storm leaves every
+    engine state field BITWISE identical to a quiet hub and to a
+    fixed-session hub — silent sessions cost exactly nothing,
+  * the batched row mirror: queued gossip coalesces into one placed
+    ExtOriginations per period (mirror_updates / 16-bytes-per-slot),
+  * the gauge surface (SESSION_GAUGES / gauge_values / expo
+    render_sessions) and a small end-to-end run_load smoke — the
+    `scripts/run_suite.py --fast` hub gate.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from swim_tpu import SwimConfig
+from swim_tpu.core import codec
+from swim_tpu.obs.health import HEALTH_RULES
+from swim_tpu.serve import hub as hub_mod
+from swim_tpu.serve.hub import (OP_BYE, OP_ECHO, OP_ECHO_REPLY, OP_HELLO,
+                                OP_REJECT, OP_WELCOME, REJ_FULL, REJ_QUEUE,
+                                SESSION_GAUGES, ServeHub, gauge_values,
+                                pack, unpack)
+from swim_tpu.types import MsgKind, Status
+
+# small knobs = fast compile; the hub semantics are size-independent
+GEOM = dict(k_indirect=1, ring_window_periods=3, suspicion_mult=2.0,
+            ring_view_c=2, ring_sel_scope="period")
+N = 256
+
+
+def wait_until(pred, timeout: float = 5.0, what: str = "condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def client_sock() -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    s.settimeout(2.0)
+    return s
+
+
+def recv_op(sock: socket.socket, op: int, timeout: float = 5.0):
+    """Drain until a frame with opcode `op` arrives; returns (a, b)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            data, _ = sock.recvfrom(65535)
+        except socket.timeout:
+            continue
+        got, a, b, _ = unpack(data)
+        if got == op:
+            return a, b
+    raise AssertionError(f"no op={op} frame within {timeout}s")
+
+
+class TestWireFormat:
+    def test_pack_unpack_roundtrip(self):
+        data = pack(hub_mod.OP_DGRAM, 7, 123456789, b"payload")
+        op, a, b, payload = unpack(data)
+        assert (op, a, b, payload) == (hub_mod.OP_DGRAM, 7, 123456789,
+                                       b"payload")
+
+    def test_rule_registered(self):
+        # the hub's eviction Finding must be a registered health rule
+        # (obs/health.py), severity warn — /metrics and dump headers
+        # pick it up by name
+        assert HEALTH_RULES["session_evicted"][0] == "warn"
+
+
+class TestAdmission:
+    def test_hello_welcome_bye_recycles_row(self):
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[5, 6], frontend="socket")
+        c = client_sock()
+        try:
+            c.sendto(pack(OP_HELLO, 42, 0), hub.address)
+            row, nonce = recv_op(c, OP_WELCOME)
+            assert nonce == 42 and row in (5, 6)
+            assert hub.report()["active"] == 1
+            c.sendto(pack(OP_BYE, row, 0), hub.address)
+            wait_until(lambda: hub.report()["active"] == 0,
+                       what="BYE to release the row")
+            # clean leave returned the row: a re-admission still works
+            c.sendto(pack(OP_HELLO, 43, 0), hub.address)
+            _, nonce2 = recv_op(c, OP_WELCOME)
+            assert nonce2 == 43
+            assert hub.report()["left"] == 1
+        finally:
+            c.close()
+            hub.close()
+
+    def test_pool_exhaustion_rejects_full(self):
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[9], frontend="socket")
+        c = client_sock()
+        try:
+            c.sendto(pack(OP_HELLO, 1, 0), hub.address)
+            recv_op(c, OP_WELCOME)
+            c.sendto(pack(OP_HELLO, 2, 0), hub.address)
+            reason, nonce = recv_op(c, OP_REJECT)
+            assert (reason, nonce) == (REJ_FULL, 2)
+            assert hub.report()["rejected_full"] == 1
+        finally:
+            c.close()
+            hub.close()
+
+    def test_full_work_queue_rejects_with_backpressure(self):
+        """The bounded-queue contract: with the admission worker wedged
+        and the queue full, a HELLO is answered REJECT(queue) straight
+        from the frontend drain — never blocking, never silently
+        dropped without the stat."""
+        import threading
+
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[1, 2, 3], queue_capacity=1,
+                       frontend="socket")
+        c = client_sock()
+        addr = c.getsockname()
+        gate = threading.Event()
+        orig_admit = hub._do_admit
+        hub._do_admit = lambda a, n: (gate.wait(10), orig_admit(a, n))
+        try:
+            # wedge the worker: it dequeues the first admit and parks on
+            # the gate; the 1-slot queue then fills behind it
+            hub._on_datagram(addr, pack(OP_HELLO, 0, 0))
+            time.sleep(0.2)       # worker picks item 0 up and parks
+            hub._on_datagram(addr, pack(OP_HELLO, 1, 0))
+            hub._on_datagram(addr, pack(OP_HELLO, 2, 0))
+            reason, _ = recv_op(c, OP_REJECT)
+            assert reason == REJ_QUEUE
+            wait_until(lambda: hub.report()["queue_drops"] >= 1,
+                       what="queue_drops stat")
+            # back-pressure is transient: the surviving queue items are
+            # admitted once the worker unwedges
+            gate.set()
+            wait_until(lambda: hub.report()["admitted"] >= 1,
+                       what="post-storm admission")
+        finally:
+            gate.set()
+            c.close()
+            hub.close()
+
+    def test_echo_answered_from_the_drain(self):
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[1], frontend="socket")
+        c = client_sock()
+        try:
+            c.sendto(pack(OP_ECHO, 11, 22), hub.address)
+            assert recv_op(c, OP_ECHO_REPLY) == (11, 22)
+            assert hub.report()["echoes"] == 1
+        finally:
+            c.close()
+            hub.close()
+
+
+class TestEviction:
+    def test_silent_session_is_evicted_with_finding(self):
+        """A session that never ACKs its mirrored pings is evicted after
+        `ack_grace` periods: a session_evicted warn Finding lands on the
+        health trail, the row is crash-gated (plan mutation — the
+        cluster detects the death organically) and is NOT recycled."""
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[17], ack_grace=1,
+                       frontend="socket")
+        try:
+            row = hub.attach()
+            assert row == 17
+            hub.step_periods(5)      # pings pile up unacked
+            wait_until(lambda: hub.report()["evicted"] == 1,
+                       what="stalled session eviction")
+            f = hub.findings()[0]
+            assert f.rule == "session_evicted"
+            assert f.severity == "warn"
+            assert f.value > f.threshold == float(hub.ack_grace)
+            assert "evicted" in f.message
+            # the row was crash-gated, not returned to the free pool
+            assert int(hub._crash[row]) <= hub.t
+            assert hub.attach() is None
+            assert hub.report()["active"] == 0
+        finally:
+            hub.close()
+
+    def test_acking_session_survives(self):
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[17], ack_grace=1,
+                       frontend="socket")
+        try:
+            row = hub.attach()
+            for _ in range(5):
+                hub.step_periods(1)
+                # in-process liveness credit: what a real client's ACK
+                # datagram does through _on_session_datagram
+                with hub._lock:
+                    c = hub._clients[row]
+                    c.pings_acked = c.pings_sent
+                    c.last_ack_t = hub.t
+            assert hub.report()["evicted"] == 0
+            assert hub.report()["active"] == 1
+        finally:
+            hub.close()
+
+
+class TestChurnNeutrality:
+    def test_join_leave_storm_is_bitwise_neutral(self):
+        """Tri-run: quiet hub vs fixed-session hub vs join/leave-storm
+        hub, same seed and geometry — every state field must stay
+        BITWISE identical.  Admissions and clean leaves touch only host
+        membership; the tensor program sees the same plan, the same
+        rnd, the same (empty) ExtOriginations batch."""
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        periods = 4
+        rows = list(range(8))
+
+        def make():
+            return ServeHub(cfg, reserved_rows=rows, seed=3,
+                            ack_grace=periods + 2, frontend="socket")
+
+        quiet, fixed, storm = make(), make(), make()
+        try:
+            for _ in rows:
+                fixed.attach()
+            held: list[int] = []
+            for t in range(periods):
+                quiet.step_periods(1)
+                fixed.step_periods(1)
+                # storm arm: churn between every period — join a few,
+                # leave a few, leave-all on the last period
+                for _ in range(3):
+                    r = storm.attach()
+                    if r is not None:
+                        held.append(r)
+                storm.step_periods(1)
+                for r in held[: 2 + t % 2]:
+                    storm.detach(r)
+                del held[: 2 + t % 2]
+            for r in held:
+                storm.detach(r)
+            assert storm.report()["admitted"] > storm.report()["active"]
+            for name in quiet.state._fields:
+                q = np.asarray(getattr(quiet.state, name))
+                np.testing.assert_array_equal(
+                    q, np.asarray(getattr(fixed.state, name)),
+                    err_msg=f"fixed-vs-quiet diverged on {name}")
+                np.testing.assert_array_equal(
+                    q, np.asarray(getattr(storm.state, name)),
+                    err_msg=f"storm-vs-quiet diverged on {name}")
+        finally:
+            quiet.close()
+            fixed.close()
+            storm.close()
+
+
+class TestBatchedMirror:
+    def test_gossip_coalesces_into_one_placed_batch(self):
+        """Session gossip queued before a period rides ONE placed
+        ExtOriginations (mirror_updates += 1, 16 bytes per slot), and
+        the injected opinion actually lands in tensor state."""
+        cfg = SwimConfig(n_nodes=N, **GEOM)
+        hub = ServeHub(cfg, reserved_rows=[3], ack_grace=99,
+                       frontend="socket")
+        try:
+            row = hub.attach()
+            subject = 77
+            msg = codec.Message(
+                kind=MsgKind.PING, sender=row, probe_seq=1,
+                gossip=(codec.WireUpdate(
+                    member=subject, status=Status.SUSPECT, incarnation=0,
+                    addr=("sim", subject), origin=row),))
+            hub._on_session_datagram(None, row, (row + 1) % N,
+                                     codec.encode(msg))
+            assert hub.report()["datagrams"] == 1
+            hub.step_periods(1)
+            rep = hub.report()
+            assert rep["mirror_updates"] == 1
+            assert rep["mirror_bytes"] == 16 * hub.ext_capacity
+            assert rep["mirror_bytes_per_period"] == 16 * hub.ext_capacity
+            # the injected suspicion is now an opinion the engine holds
+            subj = np.asarray(hub.state.subject)
+            keys = np.asarray(hub.state.rkey)
+            assert (keys[subj == subject] > 0).any(), (
+                "injected opinion never landed in the rumor table")
+        finally:
+            hub.close()
+
+
+class TestGaugeSurface:
+    REPORT = {"nodes": 8, "admitted": 2, "evicted": 1, "active": 1,
+              "mirror_bytes_per_period": 1024,
+              "sessions": [{"row": 3, "clock_lag_periods": 0},
+                           {"row": 5, "clock_lag_periods": 4}]}
+
+    def test_gauge_values_cover_the_registry(self):
+        vals = gauge_values(self.REPORT)
+        assert set(vals) == set(SESSION_GAUGES)
+        assert vals["swim_session_admitted"] == 2.0
+        assert vals["swim_session_clock_lag_periods"] == 4.0  # worst row
+
+    def test_render_sessions_exposition(self):
+        from swim_tpu.obs import expo
+
+        text = expo.render_sessions(self.REPORT)
+        assert "swim_session_active" in text
+        assert 'session="5"' in text          # per-session lag series
+        for name in SESSION_GAUGES:
+            assert name in text
+
+
+class TestLoadHarnessSmoke:
+    def test_run_load_small(self):
+        """End-to-end smoke of the serve-tier harness (the run_suite
+        --fast hub gate): both arms admit every session, the storm arm
+        stays bitwise-parity, RTT samples exist."""
+        from swim_tpu.serve import load as serve_load
+
+        res = serve_load.run_load(n_nodes=512, sessions=8, periods=2,
+                                  n_sockets=4, echo_samples=50)
+        assert res["ok_parity"], res
+        assert res["clean"]["admission"]["sessions"] == 8
+        assert res["storm"]["admission"]["sessions"] == 8
+        assert res["clean"]["rtt_ms"]["samples"] > 0
+        assert res["p99_rtt_ms"] >= res["p50_rtt_ms"] >= 0.0
+        assert res["clean"]["digest"] == res["storm"]["digest"]

@@ -884,6 +884,27 @@ def bench_serve(n_nodes: int, periods: int) -> dict:
                                periods=max(periods or 3, 2))
 
 
+def bench_servetrace(n_nodes: int, periods: int) -> dict:
+    """Serve-path tracing overhead tier (swim_tpu/obs/servetrace):
+    per-period phase timers + datagram spans ON vs OFF on the same
+    deterministic in-process session workload.
+
+    Same contract form as bench_telemetry_overhead: the measured
+    periods/sec overhead must stay <= 5% (telemetry precedent 1.45%),
+    and `ok_parity` pins the traced arm's engine-state digest bitwise
+    equal to the untraced arm's — tracing reads clocks and appends to
+    host buffers, it must never perturb the device program.  The
+    `serve_unattributed_ms` / `serve_nodes` pair the parent emits
+    auto-registers the inverted trend family (unattributed period wall
+    regresses by RISING)."""
+    from swim_tpu.serve import load as serve_load
+
+    n = n_nodes or 65_536
+    sessions = 256 if n >= 16_384 else 32
+    return serve_load.trace_overhead(n_nodes=n, sessions=sessions,
+                                     periods=max(periods or 6, 2))
+
+
 TIER_FNS = {"dense": bench_dense, "rumor": bench_rumor,
             "shard": bench_shard, "ring": bench_ring,
             "ringp": functools.partial(bench_ring,
@@ -920,7 +941,7 @@ def run_tier_child(args) -> int:
         jax.config.update("jax_platforms", args.platform)
     # else ("default"/"auto"): leave the ambient platform alone.
     if args._tier in ("telemetry", "profiler", "scenariobatch",
-                      "memwall", "audit", "serve"):
+                      "memwall", "audit", "serve", "servetrace"):
         # Artifact tiers share one shape: run a self-contained contract
         # measurement (on/off overhead at the lean anchor, the
         # batched-vs-serial scenario fleet, or the AOT memory-wall
@@ -930,7 +951,8 @@ def run_tier_child(args) -> int:
               "scenariobatch": bench_scenario_batch,
               "memwall": bench_memwall,
               "audit": bench_audit,
-              "serve": bench_serve}[args._tier]
+              "serve": bench_serve,
+              "servetrace": bench_servetrace}[args._tier]
         artifact = {"scenariobatch": "scenariobatch_fleet.json",
                     "memwall": "memwall_report.json",
                     "audit": "audit_bench.json",
@@ -955,6 +977,11 @@ def run_tier_child(args) -> int:
                         "serve arms diverged (storm-vs-clean state "
                         "digest, or a session failed admission) — "
                         "latency/admission numbers not publishable",
+                    "servetrace":
+                        "traced arm's engine-state digest diverged "
+                        "from the untraced arm — tracing perturbed "
+                        "the device program, overhead number not "
+                        "publishable",
                 }.get(args._tier,
                       "batched fleet diverged from serial "
                       "(lane bitwise or verdict parity) — "
@@ -1071,8 +1098,8 @@ def main() -> int:
                     choices=("dense", "rumor", "shard", "ring", "ringp",
                              "ringpull", "ringshard", "ringshardc",
                              "telemetry", "profiler", "scenariobatch",
-                             "memwall", "audit", "serve", "flagship",
-                             "both", "all"))
+                             "memwall", "audit", "serve", "servetrace",
+                             "flagship", "both", "all"))
     ap.add_argument("--nodes", type=int, default=0)
     ap.add_argument("--periods", type=int, default=0)
     ap.add_argument("--platform", default="auto",
@@ -1167,6 +1194,11 @@ def main() -> int:
             # geometry); smoke shrinks to a 4096-node hub smoke
             nodes = args.nodes or (4096 if args.smoke else 1_000_000)
             p = args.periods or 3
+        if tier == "servetrace":
+            # tracing-overhead contract runs socket-free at a hub-sized
+            # anchor — the number is the tracer's, not the network's
+            nodes = args.nodes or (4096 if args.smoke else 65_536)
+            p = args.periods or 6
         if tier in ("rumor", "shard") and nodes >= 262_144 \
                 and not args.periods:
             # The scatter-delivery engines serialize their updates on
@@ -1304,7 +1336,7 @@ def main() -> int:
         print(json.dumps(out))
         return 0
 
-    if args.tier in ("telemetry", "profiler"):
+    if args.tier in ("telemetry", "profiler", "servetrace"):
         # Contract tiers, not throughput tiers: the headline value is the
         # measured on/off overhead percentage (<= 5.0 keeps the contract).
         r = results.get(args.tier, {})
@@ -1315,6 +1347,13 @@ def main() -> int:
                    "value": r["overhead_pct"], "unit": "percent",
                    "platform": platform}
             out.update({k: v for k, v in r.items() if k != "ok"})
+            if args.tier == "servetrace":
+                # Trend auto-registration: serve_unattributed_ms /
+                # serve_nodes pair — obs/trend.py's inverted family
+                # (unattributed period wall regresses by RISING, gated
+                # exactly like a p/s drop).
+                out["serve_nodes"] = r["nodes"]
+                out["serve_unattributed_ms"] = r["serve_unattributed_ms"]
         else:
             out = {"metric": (f"{args.tier} overhead pct (tier failed, "
                               f"{platform})"),

@@ -315,6 +315,72 @@ def check_session_gauges() -> list[str]:
     return problems
 
 
+def check_serve_trace_gauges() -> list[str]:
+    """Problems with the swim_serve_* trace gauge surface ([] = clean).
+
+    Mirrors check_session_gauges for obs/servetrace.py: (a) the literal
+    `swim_serve_*` keys in servetrace.gauge_values (AST source scan)
+    must be exactly SERVE_TRACE_GAUGES; (b) render_serve_trace over a
+    synthetic phase summary — including per-phase rows, since the three
+    phase gauges render one labeled series per phase — must emit
+    exactly the SERVE_TRACE_GAUGES names; (c) every name must be a
+    legal Prometheus metric name; (d) the hub's `ext_mirror_overflow`
+    warn rule must be declared in HEALTH_RULES so its Findings render
+    through the health gauge surface.
+    """
+    import re
+
+    from swim_tpu.obs.expo import render_serve_trace
+    from swim_tpu.obs.health import HEALTH_RULES
+    from swim_tpu.obs.servetrace import PHASES, SERVE_TRACE_GAUGES
+
+    problems: list[str] = []
+    name_re = re.compile(r"^[a-z][a-z0-9_]*$")
+    for name in SERVE_TRACE_GAUGES:
+        if not name_re.match(name):
+            problems.append(f"SERVE_TRACE_GAUGES entry {name!r} is not "
+                            "a legal Prometheus metric name")
+    st_py = os.path.join(os.path.dirname(NODE_PY), os.pardir,
+                         "obs", "servetrace.py")
+    with open(st_py) as f:
+        tree = ast.parse(f.read(), filename=st_py)
+    fn = next((n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)
+               and n.name == "gauge_values"), None)
+    if fn is None:
+        problems.append("obs/servetrace.py has no gauge_values()")
+    else:
+        written = {n.value for n in ast.walk(fn)
+                   if isinstance(n, ast.Constant)
+                   and isinstance(n.value, str)
+                   and n.value.startswith("swim_serve_")}
+        if written != set(SERVE_TRACE_GAUGES):
+            problems.append(
+                f"servetrace.gauge_values writes {sorted(written)} but "
+                f"SERVE_TRACE_GAUGES declares "
+                f"{sorted(SERVE_TRACE_GAUGES)} — keep the two in "
+                "lockstep")
+    fake = {"nodes": 4096, "periods": 3,
+            "phases": {name: {"mean_ms": 1.0, "p99_ms": 2.0,
+                              "fraction": 0.2} for name in PHASES},
+            "period_ms": {"mean": 5.0, "total": 15.0},
+            "unattributed_ms": 0.1}
+    emitted = {line.split("{")[0].split(" ")[0]
+               for line in render_serve_trace(fake).splitlines()
+               if line and not line.startswith("#")}
+    if emitted != set(SERVE_TRACE_GAUGES):
+        problems.append(
+            f"render_serve_trace emits {sorted(emitted)} but "
+            f"SERVE_TRACE_GAUGES declares {sorted(SERVE_TRACE_GAUGES)} "
+            "— keep the renderer and the gauge table in lockstep")
+    if "ext_mirror_overflow" not in HEALTH_RULES:
+        problems.append(
+            "serve/hub.py fires `ext_mirror_overflow` Findings but "
+            "HEALTH_RULES does not declare the rule — undeclared rules "
+            "never reach the swim_health_findings gauge surface")
+    return problems
+
+
 def check_ici_terms() -> list[str]:
     """Problems with the auditor's ICI tally vocabulary ([] = clean).
 
@@ -446,21 +512,24 @@ def check_trend_tier_keys() -> list[str]:
     peak = set(re.findall(r'"([a-z0-9]+)_peak_bytes"', src))
     sessions = set(re.findall(r'"([a-z0-9]+)_sessions"', src))
     p99 = set(re.findall(r'"([a-z0-9]+)_p99_ms"', src))
+    unattr = set(re.findall(r'"([a-z0-9]+)_unattributed_ms"', src))
     nodes = set(re.findall(r'"([a-z0-9]+)_nodes"', src))
     problems: list[str] = []
     for suffix, tiers in (("periods_per_sec", pps), ("peak_bytes", peak),
-                          ("sessions", sessions), ("p99_ms", p99)):
+                          ("sessions", sessions), ("p99_ms", p99),
+                          ("unattributed_ms", unattr)):
         for tier in sorted(tiers - nodes):
             problems.append(
                 f"bench.py writes \"{tier}_{suffix}\" but never "
                 f"\"{tier}_nodes\" — the trend engine needs both to "
                 "register the series")
-    for tier in sorted(nodes - (pps | peak | sessions | p99)):
+    for tier in sorted(nodes - (pps | peak | sessions | p99 | unattr)):
         problems.append(
             f"bench.py writes \"{tier}_nodes\" but no metric key "
             f"(\"{tier}_periods_per_sec\", \"{tier}_peak_bytes\", "
-            f"\"{tier}_sessions\" or \"{tier}_p99_ms\") — the trend "
-            "engine needs the pair to register the series")
+            f"\"{tier}_sessions\", \"{tier}_p99_ms\" or "
+            f"\"{tier}_unattributed_ms\") — the trend engine needs the "
+            "pair to register the series")
     return problems
 
 
@@ -502,6 +571,9 @@ def main() -> int:
     for problem in check_session_gauges():
         ok = False
         print(f"session-gauge lint: {problem}", file=sys.stderr)
+    for problem in check_serve_trace_gauges():
+        ok = False
+        print(f"serve-trace-gauge lint: {problem}", file=sys.stderr)
     for problem in check_ici_terms():
         ok = False
         print(f"ici-term lint: {problem}", file=sys.stderr)
@@ -519,6 +591,7 @@ def main() -> int:
     from swim_tpu.obs.health import HEALTH_RULES
     from swim_tpu.obs.memwall import MEM_GAUGES
     from swim_tpu.obs.prof import PROF_GAUGES
+    from swim_tpu.obs.servetrace import SERVE_TRACE_GAUGES
     from swim_tpu.serve.hub import SESSION_GAUGES
     from swim_tpu.sim.scenario import LIBRARY
 
@@ -529,6 +602,7 @@ def main() -> int:
           f"{len(MEM_GAUGES)} memory gauges, "
           f"{len(AUDIT_GAUGES)} audit gauges, "
           f"{len(SESSION_GAUGES)} session gauges, "
+          f"{len(SERVE_TRACE_GAUGES)} serve-trace gauges, "
           f"{len(ICI_TERMS)} tally terms and "
           f"{len(LIBRARY)} library scenarios: "
           f"{'OK' if ok else 'FAIL'}")

@@ -78,6 +78,7 @@ FAST_FILES = (
     "tests/test_sampling.py",
     "tests/test_audit.py",
     "tests/test_serve.py",
+    "tests/test_servetrace.py",
 )
 
 # Scenario gate: the library's sub-minute adversarial scenarios, run via

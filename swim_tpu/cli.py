@@ -23,7 +23,10 @@ Subcommands:
   serve     — the serving hub (swim_tpu/serve): 'serve bench' runs the
               10^3-client load harness against a >=1M-node ring engine
               and defends admission rate + echo RTT p50/p99 under a
-              replay/duplication storm (bitwise state parity)
+              replay/duplication storm (bitwise state parity); 'serve
+              trace' attributes the echo-RTT p99 tail to the hub's
+              five period phases (obs/servetrace.py) and writes the
+              byte-stable bench_results/serve_trace.json
 """
 
 from __future__ import annotations
@@ -575,11 +578,33 @@ def _cmd_audit(args: argparse.Namespace) -> int:
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
-    if args.action != "bench":
-        print("serve: only the 'bench' action exists (the embeddable "
+    if args.action not in ("bench", "trace"):
+        print("serve: actions are 'bench' and 'trace' (the embeddable "
               "hub API is swim_tpu.serve.ServeHub)", file=sys.stderr)
         return 2
     from swim_tpu.serve import load as serve_load
+
+    if args.action == "trace":
+        from swim_tpu.obs import analyze
+
+        res = serve_load.run_trace(
+            n_nodes=args.nodes, sessions=args.sessions,
+            periods=args.periods, seed=args.seed,
+            n_sockets=args.sockets, echo_samples=args.echo_samples,
+            frontend=args.frontend)
+        if args.out:
+            os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                        exist_ok=True)
+            # byte-stable on re-read: sorted keys, no timestamps
+            with open(args.out, "w") as f:
+                json.dump(res, f, indent=1, sort_keys=True)
+                f.write("\n")
+        if args.json:
+            print(json.dumps(res, indent=2, sort_keys=True))
+        else:
+            print(analyze.render_report(res, title="serve trace"))
+            print(f"digests_match: {res['digests_match']}")
+        return 0 if res.get("ok_parity") else 1
 
     res = serve_load.run_load(
         n_nodes=args.nodes, sessions=args.sessions,
@@ -852,10 +877,15 @@ def build_parser() -> argparse.ArgumentParser:
     sv = sub.add_parser(
         "serve", help="serving hub: async session admission over a "
                       "free-running ring engine (swim_tpu/serve)")
-    sv.add_argument("action", choices=("bench",),
+    sv.add_argument("action", choices=("bench", "trace"),
                     help="'bench': the 10^3-client load harness "
                          "(clean arm vs replay/duplication storm; "
-                         "exit 1 unless the arms stay bitwise-parity)")
+                         "exit 1 unless the arms stay bitwise-parity); "
+                         "'trace': tail-latency attribution — an "
+                         "untraced parity arm then a traced arm whose "
+                         "phase timeline decomposes the echo-RTT p99 "
+                         "(exit 1 unless bitwise parity AND >=90% of "
+                         "the tail is attributed)")
     sv.add_argument("--nodes", type=int, default=1_000_000)
     sv.add_argument("--sessions", type=int, default=1000)
     sv.add_argument("--periods", type=int, default=3)
@@ -872,7 +902,10 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--out", default="",
                     help="write the full result JSON here "
                          "(bench.py --tier serve owns the committed "
-                         "bench_results/serve_load.json)")
+                         "bench_results/serve_load.json; 'serve trace' "
+                         "--out owns bench_results/serve_trace.json, "
+                         "written byte-stable: sorted keys, no "
+                         "timestamps)")
     sv.add_argument("--json", action="store_true",
                     help="print the full result (arms included)")
     sv.set_defaults(fn=_cmd_serve)

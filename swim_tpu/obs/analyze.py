@@ -40,7 +40,13 @@ from swim_tpu.obs import health as health_mod
 NEVER = 2**31 - 1                     # sim/runner.py's not-yet sentinel
 E_OVER_E_MINUS_1 = math.e / (math.e - 1)
 RECORDER_KIND = "swim_tpu_flight_recorder"
-SPAN_KINDS = ("probe", "suspicion")
+SPAN_KINDS = ("probe", "suspicion", "serve")
+
+# ServeHub._period phase order (obs/servetrace.py PHASES) — kept as a
+# literal so this module stays import-light for tpu_watch attachment.
+SERVE_PHASES = ("evict_scan", "inject_coalesce", "engine_step",
+                "s_off_get", "mirror_fanout")
+SERVE_COVERAGE_CONTRACT_PCT = 90.0
 
 
 # --------------------------------------------------------------- detection
@@ -229,6 +235,10 @@ def analyze_spans(rows: Iterable[Mapping[str, Any]]) -> dict:
     rtts: list[float] = []
     susp_outcomes: dict[str, int] = {}
     susp_durations: list[float] = []
+    serve_outcomes: dict[str, int] = {}
+    serve_queue_waits: list[float] = []
+    serve_flush_delays: list[float] = []
+    serve_echo_durs: list[float] = []
     indirect_rescues = 0
     n = 0
     for r in rows:
@@ -237,6 +247,19 @@ def analyze_spans(rows: Iterable[Mapping[str, Any]]) -> dict:
                if r.get("end") is not None else None)
         for _, name in r.get("events", ()):
             events[name] = events.get(name, 0) + 1
+        if r.get("kind") == "serve":
+            out = r.get("outcome") or "open"
+            serve_outcomes[out] = serve_outcomes.get(out, 0) + 1
+            marks = {name: t for t, name in r.get("events", ())}
+            if "queued" in marks and "handled" in marks:
+                serve_queue_waits.append(marks["handled"]
+                                         - marks["queued"])
+            if "queued" in marks and "flush" in marks:
+                serve_flush_delays.append(marks["flush"]
+                                          - marks["queued"])
+            if out == "echo_reply" and dur is not None:
+                serve_echo_durs.append(float(dur))
+            continue
         if r.get("kind") == "probe":
             out = r.get("outcome") or "open"
             probe_outcomes[out] = probe_outcomes.get(out, 0) + 1
@@ -278,6 +301,98 @@ def analyze_spans(rows: Iterable[Mapping[str, Any]]) -> dict:
         if susp_durations:
             arr = np.asarray(susp_durations)
             report["suspicions"]["duration_mean_s"] = float(arr.mean())
+    serves = sum(serve_outcomes.values())
+    if serves:
+        serve: dict[str, Any] = {
+            "total": serves,
+            "outcomes": dict(sorted(serve_outcomes.items())),
+        }
+        # stage separations — the span schema's whole point: queue wait
+        # (bounded work queue) and coalesce-batching delay (gossip
+        # waiting for its ExtOriginations flush period) vs device time
+        for key, vals in (("queue_wait", serve_queue_waits),
+                          ("flush_delay", serve_flush_delays),
+                          ("echo", serve_echo_durs)):
+            if vals:
+                arr = np.asarray(vals) * 1e3
+                serve[f"{key}_mean_ms"] = round(float(arr.mean()), 4)
+                serve[f"{key}_p99_ms"] = round(
+                    float(np.percentile(arr, 99)), 4)
+        report["serve"] = serve
+    return report
+
+
+# --------------------------------------------------------- serve attribution
+
+def summarize_serve(frames: Iterable[Mapping[str, Any]],
+                    echo_windows: Iterable[Iterable[float]],
+                    phase_summary: Mapping[str, Any] | None = None,
+                    contract_pct: float = SERVE_COVERAGE_CONTRACT_PCT,
+                    ) -> dict:
+    """Decompose the measured echo-RTT tail into serve-path phases.
+
+    `frames` are obs/servetrace.py period frames — absolute
+    `[name, t_begin, t_end]` phase intervals on the shared monotonic
+    clock.  `echo_windows` are the load harness's CLIENT-side
+    `[t_send, t_recv]` stamps per echo sample, same clock (loopback,
+    one host).  A tail echo is slow because the frontend drain sat
+    behind whatever the engine thread was doing, so the overlap of its
+    wall window with the phase intervals IS the attribution — measured,
+    not modeled.  The p99 tail (samples at/above the p99 RTT) must be
+    >=`contract_pct` covered by named phases or the report says
+    `attributed: false` and the residual stays `unattributed` —
+    never silently re-binned.
+    """
+    frames = list(frames)
+    windows = [(float(w[0]), float(w[1])) for w in echo_windows]
+    rtts_ms = np.asarray([(e - b) * 1e3 for b, e in windows], np.float64)
+    report: dict[str, Any] = {
+        "kind": "serve_trace",
+        "periods": len(frames),
+        "phase_names": list(SERVE_PHASES),
+        "contract_pct": float(contract_pct),
+    }
+    if phase_summary is not None:
+        report["phases"] = dict(phase_summary.get("phases") or {})
+        report["period_ms"] = dict(phase_summary.get("period_ms") or {})
+    if not len(rtts_ms) or not frames:
+        report.update({"echo": {"samples": int(len(rtts_ms))},
+                       "coverage_pct": 0.0, "attributed": False,
+                       "reason": "no echo windows or no traced frames"})
+        return report
+    p50, p99, p999 = (float(np.percentile(rtts_ms, q))
+                      for q in (50.0, 99.0, 99.9))
+    report["echo"] = {"samples": int(len(rtts_ms)),
+                      "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+                      "p999_ms": round(p999, 3)}
+    tail = [(b, e) for (b, e) in windows if (e - b) * 1e3 >= p99]
+    intervals = [(name, float(pb), float(pe))
+                 for f in frames for name, pb, pe in f.get("phases", ())]
+    per_phase = {name: 0.0 for name in SERVE_PHASES}
+    tail_wall = 0.0
+    for b, e in tail:
+        tail_wall += e - b
+        for name, pb, pe in intervals:
+            ov = min(e, pe) - max(b, pb)
+            if ov > 0.0:
+                per_phase[name] = per_phase.get(name, 0.0) + ov
+    n_tail = len(tail)
+    mean_tail_ms = tail_wall / n_tail * 1e3
+    decomp = {name: round(per_phase[name] / n_tail * 1e3, 4)
+              for name in per_phase}
+    attributed_ms = sum(decomp.values())
+    decomp["unattributed"] = round(
+        max(0.0, mean_tail_ms - attributed_ms), 4)
+    coverage = (100.0 * attributed_ms / mean_tail_ms
+                if mean_tail_ms > 0 else 0.0)
+    report.update({
+        "tail": {"spans": n_tail, "threshold_ms": round(p99, 3),
+                 "mean_ms": round(mean_tail_ms, 3)},
+        "p99_attribution_ms": decomp,
+        "unattributed_ms": decomp["unattributed"],
+        "coverage_pct": round(min(coverage, 100.0), 2),
+        "attributed": coverage >= contract_pct,
+    })
     return report
 
 
@@ -450,6 +565,32 @@ def render_report(report: Mapping[str, Any], title: str = "") -> str:
         lines.append(f"trace spans · {report.get('spans', 0)} spans")
         section("probes", report.get("probes"))
         section("suspicions", report.get("suspicions"))
+        section("serve", report.get("serve"))
+    elif report.get("kind") == "serve_trace":
+        # two shapes share the kind: summarize_serve's flat report and
+        # serve/load.run_trace's payload, which nests it under
+        # "attribution" — render from whichever level carries it
+        att = report.get("attribution") or report
+        head = (f"serve trace · {report.get('periods', 0)} periods · "
+                f"{(att.get('echo') or {}).get('samples', 0)} echo "
+                f"samples")
+        lines.append(head)
+        section("echo", att.get("echo"))
+        section("tail", att.get("tail"))
+        decomp = att.get("p99_attribution_ms") or {}
+        if decomp:
+            lines.append("p99 attribution (ms):")
+            for name, ms in decomp.items():
+                lines.append(f"  {name}: {_fmt_val(ms)}")
+        section("period_ms", att.get("period_ms"))
+        for key in ("coverage_pct", "contract_pct"):
+            if key in att:
+                lines.append(f"{key}: {_fmt_val(att[key])}")
+        ok = att.get("attributed")
+        lines.append("attribution: "
+                     + ("ok (>= contract)" if ok else "UNATTRIBUTED"))
+        if att.get("reason"):
+            lines.append(f"  reason: {att['reason']}")
     else:   # merged multi-file report
         for group, sub in report.items():
             for path, rep in sub.items():

@@ -281,6 +281,42 @@ def render_sessions(report: dict,
     return "\n".join(lines) + "\n"
 
 
+def render_serve_trace(summary: dict,
+                       labels: dict[str, str] | None = None) -> str:
+    """One obs/servetrace.py phase summary as swim_serve_* gauges
+    (names pinned in servetrace.SERVE_TRACE_GAUGES and linted against
+    this renderer by scripts/check_metrics_registry.py).  Per-phase
+    series carry a `phase` label (the five ServeHub._period phases);
+    the period wall and the unattributed residual render as plain
+    gauges.  Like the profile gauges these are point-in-time, so every
+    series carries the traced shape (nodes) when the summary knows it."""
+    # import-time jax-free: servetrace.py never imports jax
+    from swim_tpu.obs.servetrace import SERVE_TRACE_GAUGES, gauge_values
+
+    base = {**(labels or {}),
+            "nodes": str(summary.get("nodes", "?"))}
+    lines: list[str] = []
+    values = gauge_values(summary)
+    phases = summary.get("phases") or {}
+    per_phase_field = {"swim_serve_phase_ms": "mean_ms",
+                       "swim_serve_phase_p99_ms": "p99_ms",
+                       "swim_serve_phase_fraction": "fraction"}
+    for full, help_text in SERVE_TRACE_GAUGES.items():
+        lines.append(f"# HELP {full} {_escape_help(help_text)}")
+        lines.append(f"# TYPE {full} gauge")
+        field = per_phase_field.get(full)
+        if field and phases:
+            for name, row in phases.items():
+                lines.append(
+                    f"{full}{_fmt_labels(base, {'phase': str(name)})} "
+                    f"{_fmt_float(row.get(field, 0.0))}")
+        else:
+            lines.append(f"{full}{_fmt_labels(base)} "
+                         f"{_fmt_float(values[full])}")
+    assert set(values) == set(SERVE_TRACE_GAUGES)
+    return "\n".join(lines) + "\n"
+
+
 def render_audit(report: dict,
                  labels: dict[str, str] | None = None) -> str:
     """One analysis/audit.py contract report as swim_audit_* gauges

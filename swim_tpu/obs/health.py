@@ -80,6 +80,11 @@ HEALTH_RULES: dict[str, tuple[str, str]] = {
         "warn",
         "A bridge/hub session was evicted (disconnect or stall): its "
         "reserved rows were crash-gated and now die organically"),
+    "ext_mirror_overflow": (
+        "warn",
+        "Session gossip spilled past the fixed-capacity ExtOriginations "
+        "batch for consecutive periods (injections run late — raise "
+        "EXT_CAPACITY or shed gossip load)"),
 }
 
 # default thresholds; override per-monitor via HealthMonitor(thresholds=)

@@ -37,6 +37,18 @@ from swim_tpu.obs.health import HealthMonitor
 KIND = "swim_tpu_flight_recorder"
 VERSION = 1
 
+
+def write_jsonl(path: str, header: dict, rows: Any) -> str:
+    """The repo's self-describing JSONL dump convention: line 1 is a
+    header object (kind/version/...), every following line one row.
+    Shared by `FlightRecorder.dump` and the serve-path tracer's frame
+    dump (obs/servetrace.py) so every dump sniffs the same way."""
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    return path
+
 # Host-side per-period counters the study runners produce NEXT TO the
 # engine tap (sim/runner.py PeriodSeries) that are worth recording in
 # the same row — accepted by `record`, round-tripped through dumps, and
@@ -136,11 +148,7 @@ class FlightRecorder:
             header["ici_bytes"] = self.ici_bytes
         if self.monitor is not None:
             header["health"] = self.monitor.summary()
-        with open(path, "w") as f:
-            f.write(json.dumps(header) + "\n")
-            for row in self._frames:
-                f.write(json.dumps(row) + "\n")
-        return path
+        return write_jsonl(path, header, self._frames)
 
     @staticmethod
     def load(path: str) -> tuple[dict, Any]:

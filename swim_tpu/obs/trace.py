@@ -1,24 +1,32 @@
-"""Structured probe-lifecycle tracing for real nodes.
+"""Structured protocol/serve-path tracing: spans + pluggable sinks.
 
-A `Span` is one protocol episode observed by one node: a probe round
-(direct ping → indirect ping-req fan-out → ack/nack → verdict) or a
-suspicion (start → independent confirmations → refute/confirm).  Nodes
-emit spans through a pluggable `TraceSink`; the default is no sink at
-all (a `None` check on the hot path — zero allocation when tracing is
-off).
+A `Span` is one traced episode: a probe round (direct ping → indirect
+ping-req fan-out → ack/nack → verdict), a suspicion (start →
+independent confirmations → refute/confirm), or — the serving hub's
+datagram lifecycle (obs/servetrace.py) — one datagram from frontend
+receipt through the work queue or the device-mirror flush to its
+reply.  Emitters push spans through a pluggable `TraceSink`; the
+default is no sink at all (a `None` check on the hot path — zero
+allocation when tracing is off).
 
 Span schema (the JSONL shape written by `JsonlSink`):
 
-  {"kind": "probe" | "suspicion",
+  {"kind": "probe" | "suspicion" | "serve",
    "node": <observer id>, "subject": <member id>,
    "start": <clock seconds>, "end": <clock seconds>,
    "outcome": probe: "ack" | "fail";
-              suspicion: "confirmed" | "refuted" | "superseded",
+              suspicion: "confirmed" | "refuted" | "superseded";
+              serve: "echo_reply" | "gossip_flushed" | "deliver" |
+                     "ack" | "admit" | "leave" | "rejected_queue",
    "events": [[<clock seconds>, <name>], ...]}
 
 Event names: probe spans use "ping", "ping-req", "ack", "nack";
-suspicion spans use "confirm" (one per independent suspector beyond the
-originator).
+suspicion spans use "confirm" (one per independent suspector beyond
+the originator).  Serve spans (node = session row, -1 pre-admission;
+subject = wire opcode) use "queued" (bounded work-queue put), "handled"
+(worker dequeue — queue wait is handled minus queued), "flush" (the
+device-mirror period that carried a gossip update — coalesce-batching
+delay), and "send" (DELIVER/ECHO reply handed to the frontend).
 """
 
 from __future__ import annotations
@@ -30,7 +38,7 @@ from typing import IO, Protocol
 
 @dataclasses.dataclass
 class Span:
-    kind: str                 # "probe" | "suspicion"
+    kind: str                 # "probe" | "suspicion" | "serve"
     node: int
     subject: int
     start: float

@@ -44,6 +44,11 @@ _BYTES_SUFFIX = "_peak_bytes"
 # latency in ms (regresses by RISING, inverted like peak_bytes).
 _SESSIONS_SUFFIX = "_sessions"
 _P99_SUFFIX = "_p99_ms"
+# Serve-path tracing (bench.py --tier servetrace): mean echo-tail ms
+# NOT explained by a named _period phase.  Inverted — unexplained tail
+# time regressing upward means the attribution layer is losing its
+# grip on the p99, which is exactly what the gate must catch.
+_UNATTR_SUFFIX = "_unattributed_ms"
 
 DEFAULT_THRESHOLD = 0.10
 
@@ -67,6 +72,8 @@ def _samples_from_parsed(parsed: dict, *, source: str, rnd: int | None,
             tier, metric = key[:-len(_PPS_SUFFIX)], "pps"
         elif key.endswith(_BYTES_SUFFIX):
             tier, metric = key[:-len(_BYTES_SUFFIX)], "peak_bytes"
+        elif key.endswith(_UNATTR_SUFFIX):
+            tier, metric = key[:-len(_UNATTR_SUFFIX)], "unattributed_ms"
         elif key.endswith(_P99_SUFFIX):
             tier, metric = key[:-len(_P99_SUFFIX)], "p99_ms"
         elif key.endswith(_SESSIONS_SUFFIX):
@@ -157,7 +164,8 @@ def check(ser: dict[tuple, list[dict]],
         latest, last_good = rounds[-1], rounds[-2]
         drop = 1.0 - latest["pps"] / last_good["pps"] \
             if last_good["pps"] > 0 else 0.0
-        regression = -drop if metric in ("peak_bytes", "p99_ms") else drop
+        regression = -drop if metric in ("peak_bytes", "p99_ms",
+                                         "unattributed_ms") else drop
         findings.append({
             "tier": tier, "nodes": nodes, "platform": platform,
             "metric": metric,

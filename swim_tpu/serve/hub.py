@@ -67,6 +67,7 @@ import numpy as np
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.core import codec
+from swim_tpu.obs import servetrace
 from swim_tpu.obs.health import Finding
 from swim_tpu.types import MsgKind, Status, key_incarnation, key_status, \
     opinion_key
@@ -120,6 +121,10 @@ SESSION_GAUGES: dict[str, str] = {
     "swim_session_mirror_bytes_per_period":
         "Bytes of the coalesced per-step ExtOriginations placement "
         "(the obs/ici.py ext_mirror_rows term: 16 per slot)",
+    "swim_session_mirror_spill_slots":
+        "Queued gossip slots that missed their period's fixed-capacity "
+        "ExtOriginations batch (EXT_CAPACITY spill — injected late, "
+        "never dropped; persistent spill fires ext_mirror_overflow)",
 }
 
 
@@ -137,6 +142,8 @@ def gauge_values(report: dict) -> dict[str, float]:
         "swim_session_clock_lag_periods": worst,
         "swim_session_mirror_bytes_per_period":
             float(report.get("mirror_bytes_per_period", 0)),
+        "swim_session_mirror_spill_slots":
+            float(report.get("mirror_spill_slots", 0)),
     }
 
 
@@ -266,7 +273,8 @@ class ServeHub:
                  seed: int = 0, host: str = "127.0.0.1", port: int = 0,
                  ext_capacity: int = EXT_CAPACITY, ack_grace: int = 3,
                  queue_capacity: int = 1024, frontend: str = "auto",
-                 mirror_gossip: bool = False):
+                 mirror_gossip: bool = False,
+                 trace: "servetrace.ServeTrace | bool | None" = None):
         import jax
 
         from swim_tpu.models import ring
@@ -312,7 +320,14 @@ class ServeHub:
         self._stats = {"admitted": 0, "evicted": 0, "left": 0,
                        "rejected_full": 0, "queue_drops": 0,
                        "mirror_updates": 0, "mirror_bytes": 0,
+                       "mirror_spill_slots": 0, "mirror_spill_periods": 0,
                        "datagrams": 0, "echoes": 0}
+        self._spill_streak = 0
+        # serve-path tracing (obs/servetrace.py): default OFF — a None
+        # check on every hot path, zero allocation untraced.  Tracing
+        # only reads clocks and appends to host buffers, so engine
+        # state stays bitwise identical traced-vs-untraced.
+        self.trace = servetrace.coerce(trace)
         if self.mirror_gossip:
             self._subject = np.asarray(self.state.subject)
             self._rkey = np.asarray(self.state.rkey)
@@ -361,6 +376,8 @@ class ServeHub:
         through the bounded queue, everything else reads host mirrors."""
         if len(data) < HDR.size:
             return
+        tr = self.trace
+        t_in = tr.now() if tr is not None else 0.0
         op, a, b, payload = unpack(data)
         if op == OP_ECHO:
             # answered straight from the drain: the load harness's RTT
@@ -368,21 +385,41 @@ class ServeHub:
             with self._lock:
                 self._stats["echoes"] += 1
             self.frontend.send(addr, pack(OP_ECHO_REPLY, a, b))
+            if tr is not None:
+                s = tr.datagram_span(t_in, op)
+                t = tr.now()
+                s.event(t, "send")
+                tr.emit(s.finish(t, "echo_reply"))
         elif op == OP_HELLO:
+            span = tr.datagram_span(t_in, op) if tr is not None else None
             try:
-                self._work.put_nowait(("admit", addr, a))
+                if span is None:
+                    self._work.put_nowait(("admit", addr, a))
+                else:
+                    span.event(tr.now(), "queued")
+                    self._work.put_nowait(("admit", addr, a, span))
             except queue.Full:
                 with self._lock:
                     self._stats["queue_drops"] += 1
                 self.frontend.send(addr, pack(OP_REJECT, REJ_QUEUE, a))
+                if span is not None:
+                    t = tr.now()
+                    span.event(t, "send")
+                    tr.emit(span.finish(t, "rejected_queue"))
         elif op == OP_BYE:
+            span = tr.datagram_span(t_in, op, row=a) \
+                if tr is not None else None
             try:
-                self._work.put_nowait(("leave", a, addr))
+                if span is None:
+                    self._work.put_nowait(("leave", a, addr))
+                else:
+                    span.event(tr.now(), "queued")
+                    self._work.put_nowait(("leave", a, addr, span))
             except queue.Full:
                 with self._lock:     # client may re-send; worst case the
                     self._stats["queue_drops"] += 1   # row stalls out
         elif op == OP_DGRAM:
-            self._on_session_datagram(addr, a, b, payload)
+            self._on_session_datagram(addr, a, b, payload, t_in=t_in)
 
     def _admission_worker(self) -> None:
         """Drains the bounded work queue: admissions, clean leaves,
@@ -394,12 +431,21 @@ class ServeHub:
                 return
             try:
                 kind = item[0]
+                # optional 4th element: a "serve" trace span minted at
+                # frontend receipt — "handled" marks worker dequeue, so
+                # handled-minus-queued is the work-queue wait
+                span = item[3] if len(item) > 3 else None
+                tr = self.trace
+                if span is not None and tr is not None:
+                    span.event(tr.now(), "handled")
                 if kind == "admit":
                     self._do_admit(item[1], item[2])
                 elif kind == "leave":
                     self._do_leave(item[1], item[2])
                 elif kind == "evict":
                     self._do_evict(item[1], item[2])
+                if span is not None and tr is not None:
+                    tr.emit(span.finish(tr.now(), kind))
             except Exception:  # noqa: BLE001 — one bad item must not
                 pass           # kill the admission plane
 
@@ -507,7 +553,7 @@ class ServeHub:
     # ------------------------------------------------------- session seam
 
     def _on_session_datagram(self, addr, src: int, dst: int,
-                             payload: bytes) -> None:
+                             payload: bytes, t_in: float = 0.0) -> None:
         """One DGRAM from session row `src` toward engine node `dst`
         (codec bytes).  Runs on the frontend thread; reads host mirrors
         only — the engine may be mid-step on another thread."""
@@ -516,6 +562,9 @@ class ServeHub:
             if c is None or (c.addr is not None and c.addr != addr):
                 return
             self._stats["datagrams"] += 1
+        tr = self.trace
+        if tr is not None and not t_in:
+            t_in = tr.now()          # in-process callers skip the drain
         try:
             kind = codec.peek_kind(payload)
         except codec.DecodeError:
@@ -524,13 +573,18 @@ class ServeHub:
             with self._lock:
                 c.pings_acked = c.pings_sent
                 c.last_ack_t = self.t
+            if tr is not None:
+                tr.emit(tr.datagram_span(t_in, OP_DGRAM, row=src)
+                        .finish(tr.now(), "ack"))
             return
         try:
             msg = codec.decode(payload)
         except codec.DecodeError:
             return
+        span = tr.datagram_span(t_in, OP_DGRAM, row=src) \
+            if tr is not None else None
         self._queue_injections(dst if self._alive(dst) else src,
-                               msg.gossip)
+                               msg.gossip, span=span)
         if kind == MsgKind.PING and self._alive(dst):
             # D3: answer from host state at datagram time (empty gossip
             # unless mirror_gossip — the hub trades the lockstep
@@ -539,9 +593,18 @@ class ServeHub:
                                 probe_seq=msg.probe_seq,
                                 on_behalf=msg.on_behalf)
             self._deliver(src, dst, ack)
+            if span is not None and span.end is None and not msg.gossip:
+                # pure ping (no gossip riding the mirror): the span
+                # closes at the synthesized-ack send; gossip-carrying
+                # datagrams close at their flush period instead
+                t = tr.now()
+                span.event(t, "send")
+                tr.emit(span.finish(t, "deliver"))
 
     def _queue_injections(self, hearer: int,
-                          gossip: tuple[codec.WireUpdate, ...]) -> None:
+                          gossip: tuple[codec.WireUpdate, ...],
+                          span=None) -> None:
+        first = True
         for u in gossip:
             if not 0 <= u.member < self.n:
                 continue
@@ -549,8 +612,19 @@ class ServeHub:
             if self.mirror_gossip and key <= self._best_key(u.member):
                 continue             # stale vs table mirror (D2)
             org = u.origin if 0 <= u.origin < self.n else hearer
+            if span is not None and first:
+                span.event(self.trace.now(), "queued")
             with self._lock:
-                self._inject.append((u.member, key, org, hearer))
+                if span is not None and first:
+                    # the span rides the datagram's FIRST queued slot:
+                    # its flush period stamps the coalesce-batching
+                    # delay (spilled slots flush a period late — the
+                    # span measures exactly that)
+                    self._inject.append((u.member, key, org, hearer,
+                                         span))
+                    first = False
+                else:
+                    self._inject.append((u.member, key, org, hearer))
 
     def _deliver(self, row: int, sender: int, msg: codec.Message) -> None:
         with self._lock:
@@ -570,6 +644,9 @@ class ServeHub:
         import jax
 
         ring = self._ring
+        tr = self.trace
+        if tr is not None:
+            tr.begin(self.t)
         # 1. eviction scan — a session that missed its last ack_grace
         # mirrored pings is enqueued for eviction (never evicted inline:
         # membership changes stay on the worker thread)
@@ -581,32 +658,73 @@ class ServeHub:
                 self._work.put_nowait(("evict", row, "stall"))
             except queue.Full:
                 break                # retry next period
+        if tr is not None:
+            tr.lap("evict_scan")
         # 2. the batched row mirror: coalesce every queued reserved-row
         # write into ONE placed ExtOriginations (a single device_put of
-        # the whole fixed-capacity batch — the ext_mirror_rows bytes)
+        # the whole fixed-capacity batch — the ext_mirror_rows bytes).
+        # Slots past ext_capacity SPILL to the next period: injected
+        # late, never dropped — counted, gauged, and health-ruled
+        # (ext_mirror_overflow) when the backlog persists.
         with self._lock:
             batch = self._inject[:self.ext_capacity]
             self._inject = self._inject[self.ext_capacity:]
+            spill = len(self._inject)
+            if spill > 0:
+                self._stats["mirror_spill_slots"] += spill
+                self._stats["mirror_spill_periods"] += 1
+                self._spill_streak += 1
+                if self._spill_streak >= 2:
+                    self._findings.append(Finding(
+                        rule="ext_mirror_overflow", severity="warn",
+                        period=self.t, value=float(spill),
+                        threshold=float(self.ext_capacity),
+                        message=f"ext mirror overflow: {spill} gossip "
+                                f"slots spilled past the "
+                                f"{self.ext_capacity}-slot batch for "
+                                f"{self._spill_streak} consecutive "
+                                f"periods"))
+            else:
+                self._spill_streak = 0
         if batch:
             cap = self.ext_capacity
             subject = np.full((cap,), -1, np.int32)
             key = np.zeros((cap,), np.uint32)
             origin = np.zeros((cap,), np.int32)
             hearer = np.zeros((cap,), np.int32)
-            for i, (s, k, o, h) in enumerate(batch):
+            for i, item in enumerate(batch):
+                s, k, o, h = item[:4]
                 subject[i], key[i], origin[i], hearer[i] = s, k, o, h
             ext = jax.device_put(ring.ExtOriginations(
                 subject=subject, key=key, origin=origin, hearer=hearer))
             with self._lock:
                 self._stats["mirror_updates"] += 1
                 self._stats["mirror_bytes"] += 16 * cap
+            if tr is not None:
+                # gossip spans riding this batch close at their flush:
+                # end-minus-"queued" is the coalesce-batching delay
+                t_flush = tr.now()
+                for item in batch:
+                    if len(item) > 4 and item[4].end is None:
+                        item[4].event(t_flush, "flush")
+                        tr.emit(item[4].finish(t_flush, "gossip_flushed"))
         else:
             ext = self._ext_empty    # cached device-resident empty batch
+        if tr is not None:
+            tr.lap("inject_coalesce")
         # 3. one engine period (shape-stable: no retrace on churn)
         rnd = ring.draw_period_ring(self._key, self.t, self.cfg)
         self.state = self._step(self.state, self._device_plan(), rnd,
                                 ext=ext)
+        if tr is not None:
+            # device-synced phase edge (the obs/prof.py timing rule):
+            # without it the async dispatch returns instantly and the
+            # step's wall time would masquerade as s_off_get
+            jax.block_until_ready(self.state)
+            tr.lap("engine_step")
         s_off = int(jax.device_get(rnd.s_off))
+        if tr is not None:
+            tr.lap("s_off_get")
         self.t += 1
         # 4. mirror the rotor probe of every attached session
         if self.mirror_gossip:
@@ -626,6 +744,9 @@ class ServeHub:
             self._deliver(c.row, prober, codec.Message(
                 kind=MsgKind.PING, sender=prober, probe_seq=self.t,
                 gossip=gossip))
+        if tr is not None:
+            tr.lap("mirror_fanout")
+            tr.end()
 
     # ------------------------------------------------- state decoding
     # (host mirrors; the engine_server.py shapes, used only with
@@ -691,6 +812,10 @@ class ServeHub:
                     "mirror_updates": self._stats["mirror_updates"],
                     "mirror_bytes": self._stats["mirror_bytes"],
                     "mirror_bytes_per_period": 16 * self.ext_capacity,
+                    "mirror_spill_slots":
+                        self._stats["mirror_spill_slots"],
+                    "mirror_spill_periods":
+                        self._stats["mirror_spill_periods"],
                     "datagrams": self._stats["datagrams"],
                     "echoes": self._stats["echoes"],
                     "sessions": sessions}

@@ -29,6 +29,13 @@ tests/test_ring_shard.py tri-run spirit applied to the serving seam):
 identical (sha256 over every state field) and that both admitted the
 full session count: adversarial datapath traffic — duplicated acks,
 replayed probes, echo floods — must never perturb the tensor verdict.
+
+Two trace-layer companions (obs/servetrace.py): `run_trace` re-runs
+the clean arm untraced-then-traced and decomposes the echo-RTT p99
+tail into named `_period` phases (bench_results/serve_trace.json, the
+`swim-tpu serve trace` engine), and `trace_overhead` is the
+socket-free best-of-reps measurement behind `bench.py --tier
+servetrace`'s <=5% overhead contract.
 """
 
 from __future__ import annotations
@@ -42,6 +49,7 @@ import numpy as np
 
 from swim_tpu.config import SwimConfig
 from swim_tpu.core import codec
+from swim_tpu.obs import servetrace
 from swim_tpu.serve import hub as hub_mod
 from swim_tpu.serve.hub import (HDR, OP_BYE, OP_DELIVER, OP_DGRAM, OP_ECHO,
                                 OP_ECHO_REPLY, OP_HELLO, OP_REJECT,
@@ -97,6 +105,10 @@ class _ClientArm:
         self.last_welcome = 0.0
         self._echo_sent: dict[int, float] = {}
         self.rtts_ms: list[float] = []
+        # client-side [t_send, t_recv] stamps per echo (time.monotonic
+        # — the SAME clock obs/servetrace.py frames use, so
+        # analyze.summarize_serve can overlap them for attribution)
+        self.echo_windows: list[tuple[float, float]] = []
         self.acks_sent = 0
         self._history: list[tuple[socket.socket, bytes]] = []
         self._closing = False
@@ -207,6 +219,7 @@ class _ClientArm:
                     sent = self._echo_sent.pop(a, None)
                     if sent is not None:
                         self.rtts_ms.append((now - sent) * 1e3)
+                        self.echo_windows.append((sent, now))
             elif op == OP_DELIVER:
                 # a mirrored rotor ping for row b: ACK it back through
                 # the session seam (the hub's liveness credit)
@@ -233,22 +246,53 @@ class _ClientArm:
             t.join(timeout=5.0)
 
 
+# Log-bucketed RTT histogram edges, ms: 0.125 .. ~16s doubling — wide
+# enough that a loopback p50 (~2 ms) and a GIL-stalled tail (~100 ms)
+# both land mid-range with headroom for a pathological run.
+RTT_HIST_EDGES_MS = tuple(0.125 * 2 ** k for k in range(18))
+
+
 def _percentile(vals: list[float], q: float) -> float:
+    """Linear interpolation between closest ranks (the numpy default,
+    hand-rolled so the tail arithmetic is explicit): rank = (n-1)*q/100,
+    value = v[floor] + frac*(v[ceil]-v[floor]).  Nearest-rank on a
+    small sample set overstates the tail — at 50 samples nearest-rank
+    p99 IS the max; interpolation keeps it between the top two."""
     if not vals:
         return 0.0
-    return float(np.percentile(np.asarray(vals, np.float64), q))
+    s = sorted(float(v) for v in vals)
+    rank = (len(s) - 1) * (q / 100.0)
+    lo = int(rank)
+    hi = min(lo + 1, len(s) - 1)
+    return s[lo] + (s[hi] - s[lo]) * (rank - lo)
+
+
+def _rtt_hist(vals: list[float]) -> dict:
+    """Log-bucketed RTT histogram: counts[i] holds samples in
+    [edges[i], edges[i+1]); the first bucket absorbs anything below
+    edges[0], the last anything above edges[-1]."""
+    counts = [0] * len(RTT_HIST_EDGES_MS)
+    for v in vals:
+        i = 0
+        while (i + 1 < len(RTT_HIST_EDGES_MS)
+               and v >= RTT_HIST_EDGES_MS[i + 1]):
+            i += 1
+        counts[i] += 1
+    return {"edges_ms": list(RTT_HIST_EDGES_MS), "counts": counts}
 
 
 def _run_arm(cfg: SwimConfig, sessions: int, periods: int, seed: int,
              n_sockets: int, echo_samples: int, frontend: str,
-             duplicate: float, replay: float) -> dict:
+             duplicate: float, replay: float,
+             trace: bool = False) -> dict:
+    tracer = servetrace.ServeTrace() if trace else None
     hub = ServeHub(cfg, reserved_rows=list(range(sessions)), seed=seed,
                    ext_capacity=hub_mod.EXT_CAPACITY,
                    # no evictions during the measured run: every arm
                    # must leave the plan untouched for bitwise parity
                    ack_grace=periods + 2,
                    queue_capacity=max(1024, sessions + 128),
-                   frontend=frontend)
+                   frontend=frontend, trace=tracer)
     arm = _ClientArm(hub.address, sessions, n_sockets=n_sockets,
                      duplicate=duplicate, replay=replay, seed=seed)
     try:
@@ -263,14 +307,24 @@ def _run_arm(cfg: SwimConfig, sessions: int, periods: int, seed: int,
         time.sleep(0.3)              # let in-flight ACKs drain
         digest = state_digest(hub.state)
         report = hub.report()
-        return {"admission": admission,
-                "rtt_ms": {"p50": round(_percentile(arm.rtts_ms, 50), 3),
-                           "p99": round(_percentile(arm.rtts_ms, 99), 3),
-                           "samples": len(arm.rtts_ms)},
-                "acks_sent": arm.acks_sent,
-                "step_seconds": round(step_seconds, 3),
-                "digest": digest,
-                "report": report}
+        out = {"admission": admission,
+               "rtt_ms": {"p50": round(_percentile(arm.rtts_ms, 50), 3),
+                          "p99": round(_percentile(arm.rtts_ms, 99), 3),
+                          "p999":
+                              round(_percentile(arm.rtts_ms, 99.9), 3),
+                          "hist": _rtt_hist(arm.rtts_ms),
+                          "samples": len(arm.rtts_ms)},
+               "acks_sent": arm.acks_sent,
+               "step_seconds": round(step_seconds, 3),
+               "digest": digest,
+               "report": report}
+        if tracer is not None:
+            out["trace"] = {"summary": tracer.summary(),
+                            "frames": tracer.frames(),
+                            "echo_windows":
+                                [list(w) for w in arm.echo_windows],
+                            "spans": len(tracer.span_dicts())}
+        return out
     finally:
         arm.close()
         hub.close()
@@ -309,3 +363,115 @@ def run_load(n_nodes: int = 1_000_000, sessions: int = 1000,
             "clean": clean,
             "storm": {"knobs": storm, **stormed},
             "ok_parity": ok}
+
+
+def run_trace(n_nodes: int = 1_000_000, sessions: int = 1000,
+              periods: int = 3, seed: int = 0, n_sockets: int = 16,
+              echo_samples: int = 2000, frontend: str = "auto") -> dict:
+    """Tail-latency attribution at the serve-tier shape: the
+    bench_results/serve_trace.json payload (`swim-tpu serve trace`).
+
+    Two clean arms, same seed and geometry: UNTRACED (the parity
+    baseline) then TRACED (`ServeHub(trace=...)` on).  The traced
+    arm's period frames + the clients' echo windows feed
+    analyze.summarize_serve, which decomposes the echo-RTT p99 tail
+    into per-phase milliseconds by interval overlap.  `ok_parity`
+    defends both contracts at once: the arms' engine states are
+    sha256-bitwise identical (tracing reads clocks, never inputs) AND
+    >= the contract fraction of the tail is attributed to named
+    phases."""
+    from swim_tpu.obs import analyze
+
+    cfg = SwimConfig(n_nodes=n_nodes, **SERVE_ANCHOR)
+    off = _run_arm(cfg, sessions, periods, seed, n_sockets,
+                   echo_samples, frontend, 0.0, 0.0)
+    on = _run_arm(cfg, sessions, periods, seed, n_sockets,
+                  echo_samples, frontend, 0.0, 0.0, trace=True)
+    att = analyze.summarize_serve(on["trace"]["frames"],
+                                  on["trace"]["echo_windows"],
+                                  phase_summary=on["trace"]["summary"])
+    att["nodes"] = n_nodes       # the expo renderer's shape label
+    digests_match = off["digest"] == on["digest"]
+    t_off, t_on = off["step_seconds"], on["step_seconds"]
+    return {"kind": "serve_trace",
+            "nodes": n_nodes,
+            "sessions": sessions,
+            "periods": periods,
+            "frontend": on["report"]["frontend"],
+            "anchor_cfg": dict(SERVE_ANCHOR),
+            "attribution": att,
+            "phase_summary": on["trace"]["summary"],
+            "rtt_ms": on["rtt_ms"],
+            "digest_untraced": off["digest"],
+            "digest_traced": on["digest"],
+            "digests_match": digests_match,
+            "step_seconds_untraced": t_off,
+            "step_seconds_traced": t_on,
+            "serve_unattributed_ms": att.get("unattributed_ms", 0.0),
+            "coverage_pct": att.get("coverage_pct", 0.0),
+            "ok_parity": digests_match and bool(att.get("attributed"))}
+
+
+def trace_overhead(n_nodes: int = 65_536, sessions: int = 256,
+                   periods: int = 6, seed: int = 0,
+                   reps: int = 3) -> dict:
+    """Tracing-overhead contract measurement (`bench.py --tier
+    servetrace` -> bench_results/servetrace_overhead.json).
+
+    Deterministic and socket-free so the number is the tracer's, not
+    the network's: in-process sessions, per-period ACK datagrams
+    (identical in both arms — they exercise the span path but touch
+    host counters only), best-of-`reps` periods/sec untraced vs
+    traced.  The telemetry layer's precedent is 1.45%; the contract
+    here is the same 5%.  `ok_parity` pins the arms' engine-state
+    digests bitwise equal.  The traced arm's per-period wall not
+    covered by a named phase rides along as `serve_unattributed_ms`
+    (the obs/trend.py inverted family)."""
+    cfg = SwimConfig(n_nodes=n_nodes, **SERVE_ANCHOR)
+    ack = codec.encode(codec.Message(kind=MsgKind.ACK, sender=0,
+                                     probe_seq=1))
+
+    def arm(traced: bool) -> tuple[float, str, float]:
+        best, digest, unattr = None, "", 0.0
+        for _ in range(reps):
+            hub = ServeHub(cfg, reserved_rows=list(range(sessions)),
+                           seed=seed, ack_grace=2 * periods + 4,
+                           frontend="socket", trace=traced)
+            try:
+                for _ in range(sessions):
+                    hub.attach()
+                hub.step_periods(1)      # compile + warm, untimed
+                t0 = time.monotonic()
+                for _ in range(periods):
+                    for row in range(min(32, sessions)):
+                        hub._on_session_datagram(
+                            None, row, (row + 1) % n_nodes, ack)
+                    hub.step_periods(1)
+                dt = time.monotonic() - t0
+                digest = state_digest(hub.state)
+                rep_unattr = (hub.trace.summary()["unattributed_ms"]
+                              if traced else 0.0)
+            finally:
+                hub.close()
+            if best is None or dt < best:
+                best, unattr = dt, rep_unattr
+        return float(best), digest, unattr
+
+    t_off, d_off, _ = arm(False)
+    t_on, d_on, unattr_ms = arm(True)
+    pps_off, pps_on = periods / t_off, periods / t_on
+    overhead = (pps_off - pps_on) / pps_off * 100.0
+    return {"nodes": n_nodes,
+            "sessions": sessions,
+            "periods": periods,
+            "reps": reps,
+            "pps_off": round(pps_off, 3),
+            "pps_on": round(pps_on, 3),
+            "overhead_pct": round(overhead, 2),
+            "contract_pct": 5.0,
+            "within_contract": overhead <= 5.0,
+            "digest_off": d_off,
+            "digest_on": d_on,
+            "serve_unattributed_ms": round(unattr_ms, 4),
+            "anchor_cfg": dict(SERVE_ANCHOR),
+            "ok_parity": d_off == d_on}
